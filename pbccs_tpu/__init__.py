@@ -1,0 +1,22 @@
+"""pbccs_tpu: a TPU-native circular consensus sequencing (CCS) framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of PacBio's pbccs
+(reference: /root/reference): per-ZMW subread filtering, partial-order-alignment
+drafting, Arrow pair-HMM polishing with mutation refinement, and per-base
+quality emission -- expressed as fixed-shape, batched array programs that
+`vmap` over ZMWs and `shard_map` over TPU meshes.
+
+Layer map (top to bottom), mirroring the reference's stage boundaries
+(SURVEY.md section 1) but not its implementation:
+
+  cli.py            ccs-equivalent command line driver
+  pipeline.py       per-ZMW-batch orchestration (filter -> draft -> polish -> emit)
+  runtime/          host scheduling: bucketing, ordered work pipeline, whitelist
+  poa/              draft stage: partial-order alignment (host)
+  models/arrow/     the Arrow pair-HMM statistical model (params, expectations)
+  ops/              device kernels: banded forward/backward, mutation scoring
+  parallel/         device mesh + sharding of ZMW batches
+  io/               FASTA/BAM/report IO
+"""
+
+__version__ = "0.1.0"
