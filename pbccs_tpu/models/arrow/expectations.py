@@ -1,0 +1,73 @@
+"""Closed-form per-position moments of the Arrow HMM log-likelihood.
+
+Used for the Z-score gate on reads at AddRead time: a read whose observed
+log-likelihood is many standard deviations below the model's expectation is
+dropped (reference MultiReadMutationScorer.cpp:295-319).
+
+Parity: ExpectedContextLL / PerBaseMeanAndVariance
+(reference ConsensusCore/include/ConsensusCore/Arrow/Expectations.hpp:11-57),
+vectorized over template positions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pbccs_tpu.models.arrow.params import (
+    TRANS_BRANCH,
+    TRANS_DARK,
+    TRANS_MATCH,
+    TRANS_STICK,
+    MISMATCH_PROBABILITY,
+)
+
+_TINY = 1e-30
+
+
+def per_base_mean_and_variance(trans, eps: float = MISMATCH_PROBABILITY):
+    """Per-position (mean, variance) of the log-likelihood contribution.
+
+    trans: (L, 4) natural-scale transition track.
+    Returns (mean, var), each (L,).  Padded/sentinel positions (all-zero
+    transition rows) yield mean=var=0 so masked sums are safe.
+    """
+    p_m = trans[..., TRANS_MATCH]
+    p_b = trans[..., TRANS_BRANCH]
+    p_s = trans[..., TRANS_STICK]
+    p_d = trans[..., TRANS_DARK]
+
+    l_m = jnp.log(jnp.maximum(p_m, _TINY))
+    l_b = jnp.log(jnp.maximum(p_b, _TINY))
+    l_s = jnp.log(jnp.maximum(p_s, _TINY))
+    l_d = jnp.log(jnp.maximum(p_d, _TINY))
+
+    lg3 = -jnp.log(3.0)
+    e_m, e2_m = eps * lg3, eps * lg3 * lg3
+    e_d = e2_d = 0.0
+    e_b = e2_b = 0.0
+    e_s, e2_s = lg3, lg3 * lg3
+
+    def enn(lm, ld, lb, ls, EM, ED, EB, ES):
+        md = (lm + EM) * p_m / (p_m + p_d + _TINY) + (ld + ED) * p_d / (p_m + p_d + _TINY)
+        ei = (lb + EB) * p_b / (p_b + p_s + _TINY) + (ls + ES) * p_s / (p_b + p_s + _TINY)
+        bs = ei * (p_s + p_b) / (p_m + p_d + _TINY)
+        return md + bs
+
+    mean = enn(l_m, l_d, l_b, l_s, e_m, e_d, e_b, e_s)
+    var = enn(l_m**2, l_d**2, l_b**2, l_s**2, e2_m, e2_d, e2_b, e2_s) - mean * mean
+
+    live = trans.sum(axis=-1) > 0
+    return jnp.where(live, mean, 0.0), jnp.where(live, var, 0.0)
+
+
+def window_zscore(ll, trans, start, end):
+    """Z-score of a read's LL over oriented-template positions [start, end-1)
+    (the reference sums moments over [TemplateStart, TemplateEnd-1),
+    MultiReadMutationScorer.cpp:299-317)."""
+    mean, var = per_base_mean_and_variance(trans)
+    L = trans.shape[0]
+    pos = jnp.arange(L)
+    m = (pos >= start) & (pos < end - 1)
+    mu = jnp.sum(jnp.where(m, mean, 0.0))
+    v = jnp.sum(jnp.where(m, var, 0.0))
+    return (ll - mu) / jnp.sqrt(jnp.maximum(v, _TINY))

@@ -1,0 +1,223 @@
+"""Mutation algebra (host side): single-base template edits, enumeration,
+application, and coordinate remapping.
+
+This is deliberately plain NumPy/Python: mutation lists are small, data
+dependent, and consumed by the host-driven refinement loop between batched
+device rounds (SURVEY.md section 7 step 4).  Device-side *scoring* of
+mutations lives in ops/mutation_score.py.
+
+Parity targets:
+  Mutation / ApplyMutations / TargetToQueryPositions
+      reference ConsensusCore/src/C++/Mutation.cpp:116-197,
+      ConsensusCore/include/ConsensusCore/Mutation.hpp:57-113
+  enumerators
+      reference ConsensusCore/src/C++/Arrow/MutationEnumerator.cpp:81-215
+  virtual-mutation patches
+      reference ConsensusCore/src/C++/Arrow/TemplateParameterPair.cpp:70-140
+  OrientedMutation / ReadScoresMutation
+      reference ConsensusCore/src/C++/Arrow/MultiReadMutationScorer.cpp:71-139
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import numpy as np
+
+SUBSTITUTION, INSERTION, DELETION = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Mutation:
+    """A single edit of the template.
+
+    start/end follow the reference convention: substitution replaces
+    [start, end); deletion removes [start, end); insertion inserts new_base
+    *before* position start (start == end).
+    """
+
+    start: int
+    end: int
+    mtype: int
+    new_base: int = -1  # int8 base code; -1 for deletion
+    score: float = 0.0  # filled by scoring (ScoredMutation)
+
+    @property
+    def length_diff(self) -> int:
+        if self.mtype == INSERTION:
+            return 1
+        if self.mtype == DELETION:
+            return -(self.end - self.start)
+        return 0
+
+    def with_score(self, s: float) -> "Mutation":
+        return dataclasses.replace(self, score=float(s))
+
+
+def substitution(pos: int, base: int) -> Mutation:
+    return Mutation(pos, pos + 1, SUBSTITUTION, base)
+
+
+def insertion(pos: int, base: int) -> Mutation:
+    return Mutation(pos, pos, INSERTION, base)
+
+
+def deletion(pos: int) -> Mutation:
+    return Mutation(pos, pos + 1, DELETION)
+
+
+def enumerate_all(tpl: np.ndarray, begin: int = 0, end: int | None = None) -> list[Mutation]:
+    """All ~9 single-base mutations per position
+    (AllSingleBaseMutationEnumerator, MutationEnumerator.cpp:81-110)."""
+    end = len(tpl) if end is None else min(end, len(tpl))
+    begin = max(begin, 0)
+    out: list[Mutation] = []
+    for pos in range(begin, end):
+        for b in range(4):
+            if b != tpl[pos]:
+                out.append(substitution(pos, b))
+        for b in range(4):
+            out.append(insertion(pos, b))
+        out.append(deletion(pos))
+    return out
+
+
+def enumerate_unique(tpl: np.ndarray, begin: int = 0, end: int | None = None) -> list[Mutation]:
+    """Homopolymer-deduplicated enumeration: insertions/deletions only at the
+    start of a homopolymer run (UniqueSingleBaseMutationEnumerator,
+    MutationEnumerator.cpp:111-147)."""
+    end = len(tpl) if end is None else min(end, len(tpl))
+    begin = max(begin, 0)
+    out: list[Mutation] = []
+    for pos in range(begin, end):
+        prev = tpl[pos - 1] if pos > 0 else -1
+        for b in range(4):
+            if b != tpl[pos]:
+                out.append(substitution(pos, b))
+        for b in range(4):
+            if b != prev:
+                out.append(insertion(pos, b))
+        if tpl[pos] != prev:
+            out.append(deletion(pos))
+    return out
+
+
+def unique_nearby_mutations(tpl: np.ndarray, centers: Iterable[Mutation],
+                            neighborhood: int) -> list[Mutation]:
+    """Unique mutations within +-neighborhood of prior mutations, deduplicated
+    (UniqueNearbyMutations, MutationEnumerator-inl.hpp)."""
+    seen = set()
+    out: list[Mutation] = []
+    for m in centers:
+        lo = m.start - neighborhood
+        hi = m.end + neighborhood
+        for cand in enumerate_unique(tpl, lo, hi):
+            key = (cand.start, cand.end, cand.mtype, cand.new_base)
+            if key not in seen:
+                seen.add(key)
+                out.append(cand)
+    return out
+
+
+def apply_mutations(tpl: np.ndarray, muts: Sequence[Mutation]) -> np.ndarray:
+    """Apply sorted mutations left-to-right with a running length offset
+    (ApplyMutations, Mutation.cpp:116-128)."""
+    out = list(tpl)
+    diff = 0
+    for m in sorted(muts, key=lambda m: (m.start, m.end, m.mtype, m.new_base)):
+        s = m.start + diff
+        if m.mtype == SUBSTITUTION:
+            out[s:s + (m.end - m.start)] = [m.new_base]
+        elif m.mtype == INSERTION:
+            out[s:s] = [m.new_base]
+        else:
+            del out[s:s + (m.end - m.start)]
+        diff += m.length_diff
+    return np.asarray(out, dtype=np.int8)
+
+
+def mutations_to_transcript(muts: Sequence[Mutation], tpl_len: int) -> str:
+    """MIDR transcript of sorted mutations (Mutation.cpp:130-171)."""
+    tpos = 0
+    t = []
+    for m in sorted(muts, key=lambda m: (m.start, m.end, m.mtype, m.new_base)):
+        t.append("M" * (m.start - tpos))
+        tpos = m.start
+        if m.mtype == INSERTION:
+            t.append("I")
+        elif m.mtype == DELETION:
+            n = m.end - m.start
+            t.append("D" * n)
+            tpos += n
+        else:
+            n = m.end - m.start
+            t.append("R" * n)
+            tpos += n
+    t.append("M" * (tpl_len - tpos))
+    return "".join(t)
+
+
+def target_to_query_positions(muts: Sequence[Mutation], tpl_len: int) -> np.ndarray:
+    """Old-template position -> new-template position map, length tpl_len+1
+    (TargetToQueryPositions, Mutation.cpp:173-197)."""
+    transcript = mutations_to_transcript(muts, tpl_len)
+    mtp = np.zeros(tpl_len + 1, dtype=np.int64)
+    tpos, qpos = 0, 0
+    for c in transcript:
+        if c in "MR":
+            mtp[tpos] = qpos
+            tpos += 1
+            qpos += 1
+        elif c == "I":
+            qpos += 1
+        elif c == "D":
+            mtp[tpos] = qpos
+            tpos += 1
+    mtp[tpos] = qpos
+    return mtp
+
+
+def best_subset(scored: list[Mutation], separation: int) -> list[Mutation]:
+    """Greedy top-scoring well-separated subset (BestSubset,
+    Consensus-inl.hpp:90-118).  DeleteRange there removes mutations whose
+    start lies within [best.start - sep, best.start + sep] inclusive."""
+    if separation == 0:
+        return list(scored)
+    pool = list(scored)
+    out: list[Mutation] = []
+    while pool:
+        best = max(pool, key=lambda m: m.score)
+        out.append(best)
+        lo, hi = best.start - separation, best.start + separation
+        pool = [m for m in pool if not (lo <= m.start <= hi)]
+    return out
+
+
+def reverse_complement_mutation(m: Mutation, tpl_len: int) -> Mutation:
+    """The same edit expressed on the reverse-complement template
+    (MultiReadMutationScorer.cpp:343-348)."""
+    comp = {-1: -1, 0: 3, 1: 2, 2: 1, 3: 0}
+    return Mutation(tpl_len - m.end, tpl_len - m.start, m.mtype, comp[m.new_base], m.score)
+
+
+def read_scores_mutation(m: Mutation, tstart: int, tend: int) -> bool:
+    """Does this read's template window feel this mutation?
+    (ReadScoresMutation, MultiReadMutationScorer.cpp:71-80)."""
+    if m.mtype == INSERTION:
+        return tstart <= m.end and m.start <= tend
+    return tstart < m.end and m.start < tend
+
+
+def oriented_mutation(m: Mutation, strand: int, tstart: int, tend: int) -> Mutation:
+    """Clip to the read window and express in read-frame (window) coords
+    (OrientedMutation, MultiReadMutationScorer.cpp:93-139)."""
+    if m.end - m.start > 1:
+        cs, ce = max(m.start, tstart), min(m.end, tend)
+        cm = Mutation(cs, ce, m.mtype, m.new_base, m.score)
+    else:
+        cm = m
+    if strand == 0:
+        return Mutation(cm.start - tstart, cm.end - tstart, cm.mtype, cm.new_base, cm.score)
+    comp = {-1: -1, 0: 3, 1: 2, 2: 1, 3: 0}
+    return Mutation(tend - cm.end, tend - cm.start, cm.mtype, comp[cm.new_base], cm.score)

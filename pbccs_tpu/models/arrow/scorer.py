@@ -1,0 +1,424 @@
+"""Multi-read mutation scorer: the per-ZMW polish-stage state machine.
+
+TPU re-design of ArrowMultiReadMutationScorer (reference
+ConsensusCore/src/C++/Arrow/MultiReadMutationScorer.cpp): owns the forward and
+reverse-complement template tracks, one banded alpha/beta pair per read, and
+scores candidate template mutations as batched device calls over the whole
+(read x mutation) grid instead of the reference's per-read serial loop.
+
+Host/device split: mutation lists, favorability selection and template
+splicing are host-side (they are tiny and data-dependent); window building,
+forward/backward fills, Z-scores and mutation scoring are jitted batched
+device programs with static (R, M, Imax, Jmax, W) bucket shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
+from pbccs_tpu.models.arrow.params import (
+    ArrowConfig,
+    revcomp,
+    snr_to_transition_table,
+    template_transition_params,
+)
+from pbccs_tpu.ops.fwdbwd import (
+    backward_loglik,
+    banded_backward,
+    banded_forward,
+    forward_loglik,
+)
+from pbccs_tpu.ops.mutation_score import (
+    DEL,
+    INS,
+    SUB,
+    MutationPatch,
+    extend_link_score,
+    full_refill_score,
+    make_patch,
+    scale_prefix,
+    scale_suffix,
+)
+
+# AddRead outcome codes (reference Arrow/MultiReadMutationScorer.hpp:60-61).
+ADD_SUCCESS, ADD_ALPHABETAMISMATCH, ADD_MEM_FAIL, ADD_POOR_ZSCORE, ADD_OTHER = range(5)
+
+_AB_MISMATCH_TOL = 1e-3  # reference SimpleRecursor.cpp:53
+
+
+def _next_pow2(n: int, lo: int = 8) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _setup_reads(reads, rlens, strands, tstarts, tends,
+                 tpl_f, trans_f, tpl_r, trans_r, L, width: int):
+    """Build per-read oriented windows and fill alpha/beta for each read."""
+    Jmax = tpl_f.shape[0]
+
+    def one(read, rlen, strand, ts, te):
+        ws = jnp.where(strand == 0, ts, L - te)
+        wlen = te - ts
+        idx = jnp.arange(Jmax, dtype=jnp.int32)
+        src = jnp.clip(ws + idx, 0, Jmax - 1)
+        base = jnp.where(strand == 0, tpl_f[src], tpl_r[src])
+        trans = jnp.where(strand == 0, trans_f[src], trans_r[src])
+        win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
+        win_trans = jnp.where((idx < wlen - 1)[:, None], trans, 0.0)
+        alpha = banded_forward(read, rlen, win_tpl, win_trans, wlen, width)
+        beta = banded_backward(read, rlen, win_tpl, win_trans, wlen, width)
+        ll_a = forward_loglik(alpha, rlen, wlen)
+        ll_b = backward_loglik(beta, wlen)
+        return (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
+                scale_prefix(alpha.log_scales), scale_suffix(beta.log_scales))
+
+    return jax.vmap(one)(reads, rlens, strands, tstarts, tends)
+
+
+@jax.jit
+def _zscores(lls, strands, tstarts, tends, trans_f, trans_r, L):
+    """Z-scores over the read's window of the oriented template.
+
+    Note: the reference indexes the reverse template's moments with
+    forward-frame coordinates (MultiReadMutationScorer.cpp:299-317); we use
+    the read's actual window on the oriented template, which is the intended
+    statistic (documented deviation)."""
+    mean_f, var_f = per_base_mean_and_variance(trans_f)
+    mean_r, var_r = per_base_mean_and_variance(trans_r)
+
+    def one(ll, strand, ts, te):
+        s = jnp.where(strand == 0, ts, L - te)
+        e = jnp.where(strand == 0, te, L - ts)
+        pos = jnp.arange(trans_f.shape[0])
+        m = (pos >= s) & (pos < e - 1)
+        mu = jnp.sum(jnp.where(m, jnp.where(strand == 0, mean_f, mean_r), 0.0))
+        v = jnp.sum(jnp.where(m, jnp.where(strand == 0, var_f, var_r), 0.0))
+        return (ll - mu) / jnp.sqrt(jnp.maximum(v, 1e-12))
+
+    return jax.vmap(one)(lls, strands, tstarts, tends)
+
+
+@jax.jit
+def _make_patches(tpl, trans, trans_table, L, pos, mtype, new_base):
+    return jax.vmap(lambda p, t, b: make_patch(tpl, trans, trans_table, L, p, t, b))(
+        pos, mtype, new_base)
+
+
+@jax.jit
+def _score_interior(reads, rlens, strands, tstarts, tends,
+                    win_tpl, win_trans, wlens,
+                    alpha_vals, alpha_offs, alpha_ls,
+                    beta_vals, beta_offs, beta_ls,
+                    a_prefix, b_suffix,
+                    mpos_f, mend_f, mtype,
+                    patches_f: MutationPatch, patches_r: MutationPatch):
+    """(R, M) absolute mutated-template log-likelihoods via extend+link."""
+    from pbccs_tpu.ops.fwdbwd import BandedMatrix
+
+    def per_read(read, rlen, strand, ts, te, wt, wtr, wl,
+                 av, ao, als, bv, bo, bls, apre, bsuf):
+        alpha = BandedMatrix(av, ao, als)
+        beta = BandedMatrix(bv, bo, bls)
+        read32 = read.astype(jnp.int32)
+        wt32 = wt.astype(jnp.int32)
+
+        def per_mut(pf, ef, mt, patf, patr):
+            p = jnp.where(strand == 0, pf - ts, te - ef)
+            patch = jax.tree.map(lambda a, b: jnp.where(strand == 0, a, b), patf, patr)
+            return extend_link_score(read32, rlen, wt32, wtr, wl,
+                                     alpha, beta, apre, bsuf,
+                                     p, mt, patch)
+
+        return jax.vmap(per_mut)(mpos_f, mend_f, mtype, patches_f, patches_r)
+
+    return jax.vmap(per_read)(reads, rlens, strands, tstarts, tends,
+                              win_tpl, win_trans, wlens,
+                              alpha_vals, alpha_offs, alpha_ls,
+                              beta_vals, beta_offs, beta_ls,
+                              a_prefix, b_suffix)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _score_edge(reads, rlens, win_tpl, win_trans, wlens,
+                pair_read, pair_p, pair_type,
+                patch_bases, patch_trans, patch_shift, width: int):
+    """(E,) absolute LLs via full banded refill of the mutated window."""
+
+    def one(ridx, p, mt, pb, pt, ps):
+        read = reads[ridx].astype(jnp.int32)
+        rlen = rlens[ridx]
+        wt = win_tpl[ridx].astype(jnp.int32)
+        wtr = win_trans[ridx]
+        wl = wlens[ridx]
+        patch = MutationPatch(pb, pt, ps)
+        return full_refill_score(read, rlen, wt, wtr, wl, p, mt, patch, width)
+
+    return jax.vmap(one)(pair_read, pair_p, pair_type,
+                         patch_bases, patch_trans, patch_shift)
+
+
+class ArrowMultiReadScorer:
+    """Per-ZMW polish state (MultiReadMutationScorer equivalent).
+
+    Reads are provided pre-mapped (strand + [tstart, tend) template window
+    from the draft stage).  AddRead gating (alpha/beta mating + Z-score,
+    reference MultiReadMutationScorer.cpp:276-325) happens in batch at
+    construction; gate outcomes are in `self.statuses`.
+    """
+
+    def __init__(self, tpl: np.ndarray, snr: np.ndarray,
+                 read_codes: Sequence[np.ndarray], strands: Sequence[int],
+                 tstarts: Sequence[int], tends: Sequence[int],
+                 config: ArrowConfig | None = None,
+                 min_zscore: float = float("nan"),
+                 imax: int | None = None, jmax: int | None = None):
+        self.config = config or ArrowConfig()
+        self.snr = np.asarray(snr, np.float64)
+        self.tpl = np.asarray(tpl, np.int8)
+        self.n_reads = len(read_codes)
+        self.min_zscore = min_zscore
+
+        R = _next_pow2(self.n_reads, 4)
+        self._R = R
+        self._Imax = imax or _next_pow2(max(len(r) for r in read_codes) + 8, 64)
+        self._Jmax = jmax or _next_pow2(len(tpl) + 8, 64)
+        self._W = self.config.banding.band_width
+
+        self._reads = np.full((R, self._Imax), 4, np.int8)
+        self._rlens = np.zeros(R, np.int32)
+        for i, rc in enumerate(read_codes):
+            n = min(len(rc), self._Imax)
+            self._reads[i, :n] = rc[:n]
+            self._rlens[i] = n
+        self._strands = np.zeros(R, np.int32)
+        self._strands[: self.n_reads] = strands
+        self._tstarts = np.zeros(R, np.int32)
+        self._tstarts[: self.n_reads] = tstarts
+        self._tends = np.zeros(R, np.int32)
+        self._tends[: self.n_reads] = tends
+        # padding rows: map to a trivial window to keep kernels finite
+        for i in range(self.n_reads, R):
+            self._rlens[i] = 2
+            self._reads[i, :2] = [0, 0]
+            self._tends[i] = min(2, len(tpl))
+
+        self.trans_table = snr_to_transition_table(jnp.asarray(self.snr))
+        self.active = np.zeros(R, bool)
+        self.statuses = np.full(self.n_reads, ADD_OTHER, np.int32)
+        self.zscores = np.full(self.n_reads, np.nan)
+
+        self._rebuild(first=True)
+
+    # ------------------------------------------------------------------ setup
+
+    def _template_tensors(self):
+        L = len(self.tpl)
+        padded = np.full(self._Jmax, 4, np.int8)
+        padded[:L] = self.tpl
+        tpl_f = jnp.asarray(padded)
+        trans_f = template_transition_params(tpl_f, self.trans_table, L)
+        rc = np.full(self._Jmax, 4, np.int8)
+        rc[:L] = revcomp(self.tpl)
+        tpl_r = jnp.asarray(rc)
+        trans_r = template_transition_params(tpl_r, self.trans_table, L)
+        return tpl_f, trans_f, tpl_r, trans_r
+
+    def _rebuild(self, first: bool = False):
+        """(Re)build windows + alpha/beta for all reads against self.tpl.
+
+        On the first build, gate reads (mating + Z-score).  On rebuilds after
+        ApplyMutations, only the mating check can deactivate reads
+        (reference MultiReadMutationScorer.cpp:237-267)."""
+        L = len(self.tpl)
+        self.tpl_f, self.trans_f, self.tpl_r, self.trans_r = self._template_tensors()
+        (self.win_tpl, self.win_trans, self.wlens, self.alpha, self.beta,
+         ll_a, ll_b, self.a_prefix, self.b_suffix) = _setup_reads(
+            jnp.asarray(self._reads), jnp.asarray(self._rlens),
+            jnp.asarray(self._strands), jnp.asarray(self._tstarts),
+            jnp.asarray(self._tends),
+            self.tpl_f, self.trans_f, self.tpl_r, self.trans_r,
+            jnp.int32(L), self._W)
+
+        ll_a = np.asarray(ll_a, np.float64)
+        ll_b = np.asarray(ll_b, np.float64)
+        self.baselines = ll_b
+        mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
+        mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
+
+        if first:
+            z = np.asarray(_zscores(jnp.asarray(ll_b), jnp.asarray(self._strands),
+                                    jnp.asarray(self._tstarts), jnp.asarray(self._tends),
+                                    self.trans_f, self.trans_r, jnp.int32(L)), np.float64)
+            for i in range(self.n_reads):
+                if not mated[i]:
+                    self.statuses[i] = ADD_ALPHABETAMISMATCH
+                    self.active[i] = False
+                    continue
+                self.zscores[i] = z[i]
+                if not np.isnan(self.min_zscore) and (
+                        not np.isfinite(z[i]) or z[i] < self.min_zscore):
+                    self.statuses[i] = ADD_POOR_ZSCORE
+                    self.active[i] = False
+                else:
+                    self.statuses[i] = ADD_SUCCESS
+                    self.active[i] = True
+        else:
+            self.active[: self.n_reads] &= mated[: self.n_reads]
+        self.active[self.n_reads:] = False
+
+    # ------------------------------------------------------------- scoring
+
+    def baseline_total(self) -> float:
+        return float(self.baselines[self.active].sum())
+
+    def _mutation_arrays(self, muts: Sequence[mutlib.Mutation]):
+        L = len(self.tpl)
+        M = len(muts)
+        pos_f = np.array([m.start for m in muts], np.int32)
+        end_f = np.array([m.end for m in muts], np.int32)
+        mtype = np.array([m.mtype for m in muts], np.int32)
+        base_f = np.array([m.new_base for m in muts], np.int32)
+        rcm = [mutlib.reverse_complement_mutation(m, L) for m in muts]
+        pos_r = np.array([m.start for m in rcm], np.int32)
+        base_r = np.array([m.new_base for m in rcm], np.int32)
+        return pos_f, end_f, mtype, base_f, pos_r, base_r
+
+    def score_mutations(self, muts: Sequence[mutlib.Mutation]) -> np.ndarray:
+        """Sum over active overlapping reads of (LL(mutated) - LL(current)).
+
+        Parity: MultiReadMutationScorer::Score (MultiReadMutationScorer.cpp:
+        339-368) without the serial FastScore early-exit (the masked batched
+        sum makes the same favorability decisions)."""
+        if not muts:
+            return np.zeros(0)
+        L = len(self.tpl)
+        R, nR = self._R, self.n_reads
+        pos_f, end_f, mtype, base_f, pos_r, base_r = self._mutation_arrays(muts)
+        M = len(muts)
+        Mpad = _next_pow2(M, 16)
+        pad = lambda a, fill: np.concatenate([a, np.full(Mpad - M, fill, a.dtype)])
+        pos_fp, end_fp = pad(pos_f, L // 2), pad(end_f, L // 2 + 1)
+        mtypep, base_fp = pad(mtype, SUB), pad(base_f, 0)
+        pos_rp, base_rp = pad(pos_r, L // 2), pad(base_r, 0)
+
+        patches_f = _make_patches(self.tpl_f.astype(jnp.int32), self.trans_f,
+                                  self.trans_table, jnp.int32(L),
+                                  jnp.asarray(pos_fp), jnp.asarray(mtypep),
+                                  jnp.asarray(base_fp))
+        patches_r = _make_patches(self.tpl_r.astype(jnp.int32), self.trans_r,
+                                  self.trans_table, jnp.int32(L),
+                                  jnp.asarray(pos_rp), jnp.asarray(mtypep),
+                                  jnp.asarray(base_rp))
+
+        # host-side classification per (read, mut): overlap, window coords,
+        # interior vs edge
+        ts = self._tstarts[:, None]
+        te = self._tends[:, None]
+        strand = self._strands[:, None]
+        ms, me = pos_f[None, :], end_f[None, :]
+        is_ins = (mtype == INS)[None, :]
+        overlap = np.where(is_ins, (ts <= me) & (ms <= te), (ts < me) & (ms < te))
+        p_w = np.where(strand == 0, ms - ts, te - me)
+        e_w = np.where(strand == 0, me - ts, te - ms)
+        wlen = (te - ts)
+        interior = (p_w >= 3) & (e_w <= wlen - 2)
+        act = self.active[:, None]
+        valid = act & overlap
+        int_mask = valid & interior
+        edge_mask = valid & ~interior
+
+        abs_ll = np.asarray(_score_interior(
+            jnp.asarray(self._reads), jnp.asarray(self._rlens),
+            jnp.asarray(self._strands), jnp.asarray(self._tstarts),
+            jnp.asarray(self._tends),
+            self.win_tpl, self.win_trans, self.wlens,
+            self.alpha.vals, self.alpha.offsets, self.alpha.log_scales,
+            self.beta.vals, self.beta.offsets, self.beta.log_scales,
+            self.a_prefix, self.b_suffix,
+            jnp.asarray(pos_fp), jnp.asarray(end_fp), jnp.asarray(mtypep),
+            patches_f, patches_r), np.float64)[:, :M]
+
+        totals = np.where(int_mask, abs_ll - self.baselines[:, None], 0.0).sum(axis=0)
+
+        # edge pairs via full refill
+        er, em_ = np.nonzero(edge_mask)
+        if len(er):
+            E = len(er)
+            Epad = _next_pow2(E, 8)
+            pr = np.zeros(Epad, np.int32)
+            pp = np.zeros(Epad, np.int32)
+            pt = np.zeros(Epad, np.int32)
+            pr[:E] = er
+            pp[:E] = p_w[er, em_]
+            pt[:E] = mtype[em_]
+            pb = np.zeros((Epad, 2), np.int32)
+            ptr = np.zeros((Epad, 2, 4), np.float32)
+            psh = np.zeros(Epad, np.int32)
+            pf_b = np.asarray(patches_f.bases)
+            pf_t = np.asarray(patches_f.trans)
+            pf_s = np.asarray(patches_f.shift)
+            pr_b = np.asarray(patches_r.bases)
+            pr_t = np.asarray(patches_r.trans)
+            pr_s = np.asarray(patches_r.shift)
+            fwd = self._strands[er] == 0
+            pb[:E] = np.where(fwd[:, None], pf_b[em_], pr_b[em_])
+            ptr[:E] = np.where(fwd[:, None, None], pf_t[em_], pr_t[em_])
+            psh[:E] = np.where(fwd, pf_s[em_], pr_s[em_])
+            edge_ll = np.asarray(_score_edge(
+                jnp.asarray(self._reads), jnp.asarray(self._rlens),
+                self.win_tpl, self.win_trans, self.wlens,
+                jnp.asarray(pr), jnp.asarray(pp), jnp.asarray(pt),
+                jnp.asarray(pb), jnp.asarray(ptr), jnp.asarray(psh),
+                self._W), np.float64)[:E]
+            np.add.at(totals, em_, edge_ll - self.baselines[er])
+
+        return totals
+
+    # ------------------------------------------------------------- mutation
+
+    def apply_mutations(self, muts: Sequence[mutlib.Mutation]) -> None:
+        """Splice mutations into the template, remap read windows, refill.
+
+        Parity: MultiReadMutationScorer::ApplyMutations
+        (MultiReadMutationScorer.cpp:237-267)."""
+        if not muts:
+            return
+        L = len(self.tpl)
+        mtp = mutlib.target_to_query_positions(muts, L)
+        self.tpl = mutlib.apply_mutations(self.tpl, muts)
+        newJ = _next_pow2(len(self.tpl) + 8, 64)
+        if newJ != self._Jmax:
+            self._Jmax = newJ
+        self._tstarts = mtp[np.clip(self._tstarts, 0, L)].astype(np.int32)
+        self._tends = mtp[np.clip(self._tends, 0, L)].astype(np.int32)
+        self._rebuild(first=False)
+
+    # ------------------------------------------------------------------- QVs
+
+    def consensus_qvs(self) -> np.ndarray:
+        """Per-position QVs from single-base mutation scores.
+
+        Parity: ConsensusQVs (reference Consensus-inl.hpp:277-297): only
+        negative-scoring mutations contribute exp(score); QV =
+        -10*log10(1 - 1/(1 + sum))."""
+        tpl = self.tpl
+        muts = mutlib.enumerate_unique(tpl)
+        scores = self.score_mutations(muts)
+        score_sum = np.zeros(len(tpl))
+        for m, s in zip(muts, scores):
+            if s < 0.0:
+                score_sum[m.start] += np.exp(s)
+        prob = 1.0 - 1.0 / (1.0 + score_sum)
+        prob = np.maximum(prob, np.finfo(float).tiny)
+        qv = np.round(-10.0 * np.log10(prob)).astype(np.int32)
+        return qv

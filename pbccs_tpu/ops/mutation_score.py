@@ -1,0 +1,251 @@
+"""Batched mutation scoring on device: the TPU re-design of the reference's
+Extend+Link fast path.
+
+The reference scores one candidate mutation at a time per read by recomputing
+~2 DP columns next to the mutation ("ExtendAlpha") and stitching them to the
+saved backward matrix ("LinkAlphaBeta"); see
+reference ConsensusCore/src/C++/Arrow/SimpleRecursor.cpp:373-487 (ExtendAlpha),
+:306-357 (LinkAlphaBeta) and MutationScorer.cpp:165-266 (dispatch).
+
+Here the same algebra is evaluated as one batched array program over the
+whole (mutation x read) grid: every interior mutation is exactly two banded
+affine scans plus one band dot-product, so the grid vmaps cleanly onto the
+VPU.  Mutations too close to a template end (the reference's atBegin/atEnd
+special cases) are scored by a full banded refill of the mutated window --
+they are O(template ends), not O(template length).
+
+Virtual-mutation semantics (no mutated template is ever materialized for the
+interior path) mirror TemplateParameterPair::ApplyVirtualMutation /
+GetTemplatePosition (reference TemplateParameterPair.cpp:70-140, .hpp:88-118):
+a mutation patches (base, transition) at virtual positions p-1 and p and
+index-shifts everything beyond p.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from pbccs_tpu.models.arrow.params import (
+    TRANS_BRANCH,
+    TRANS_DARK,
+    TRANS_MATCH,
+    TRANS_STICK,
+    MISMATCH_PROBABILITY,
+    context_index,
+)
+from pbccs_tpu.ops.fwdbwd import BandedMatrix, _affine_scan, _gather_band, banded_forward, forward_loglik
+
+SUB, INS, DEL = 0, 1, 2
+_TINY = 1e-30
+
+
+class MutationPatch(NamedTuple):
+    """Virtual-mutation patch on one oriented full template: new (base,
+    transition) values at virtual positions p-1 and p, plus the index shift
+    for positions beyond p."""
+
+    bases: jax.Array    # (2,) int32: virtual bases at p-1, p
+    trans: jax.Array    # (2, 4) transition rows at p-1, p
+    shift: jax.Array    # scalar int32: index offset for idx > p (0/+1/-1)
+
+
+def make_patch(tpl, trans, trans_table, tpl_len, pos, mtype, new_base) -> MutationPatch:
+    """Compute the virtual-mutation patch on a full oriented template.
+
+    tpl: (L,) int32 codes; trans: (L, 4); trans_table: (8, 4); tpl_len: L.
+    pos/mtype/new_base: the (oriented) mutation.
+    Parity: ApplyVirtualMutation (TemplateParameterPair.cpp:70-140).
+    """
+    L = jnp.asarray(tpl_len, jnp.int32)
+    Lm = tpl.shape[0]
+    get = lambda i: tpl[jnp.clip(i, 0, Lm - 1)]
+    gett = lambda i: trans[jnp.clip(i, 0, Lm - 1)]
+    ctx_of = lambda a, b: trans_table[jnp.clip(context_index(a, b), 0, 7)]
+
+    prev_b = get(pos - 1)
+    next_b = get(pos + 1)
+    cur_b = get(pos)
+    nb = jnp.asarray(new_base, jnp.int32)
+    zeros4 = jnp.zeros(4, trans.dtype)
+
+    # SUBSTITUTION
+    sub_b = jnp.stack([prev_b, nb])
+    sub_t = jnp.stack([
+        jnp.where(pos > 0, ctx_of(prev_b, nb), zeros4),
+        jnp.where(pos + 1 < L, ctx_of(nb, next_b), zeros4),
+    ])
+    # DELETION (single base); org_last = L-1
+    org_last = L - 1
+    del_b = jnp.stack([prev_b, next_b])
+    mid = (pos > 0) & (pos < org_last)
+    del_t = jnp.stack([
+        jnp.where(mid, ctx_of(prev_b, next_b), zeros4),
+        jnp.where(pos < org_last, gett(pos + 1), zeros4),
+    ])
+    # INSERTION before pos
+    ins_b = jnp.stack([prev_b, nb])
+    ins_t = jnp.stack([
+        jnp.where(pos > 0, ctx_of(prev_b, nb), zeros4),
+        jnp.where(pos < L, ctx_of(nb, cur_b), zeros4),
+    ])
+
+    mtype = jnp.asarray(mtype, jnp.int32)
+    bases = jnp.select([mtype == SUB, mtype == INS], [sub_b, ins_b], del_b)
+    transp = jnp.select([mtype == SUB, mtype == INS], [sub_t, ins_t], del_t)
+    shift = jnp.select([mtype == SUB, mtype == INS], [jnp.int32(0), jnp.int32(-1)], jnp.int32(1))
+    return MutationPatch(bases, transp, shift)
+
+
+def _virtual_base(win_tpl, p, patch: MutationPatch, idx):
+    """Virtual-template base at window index idx (int32)."""
+    Jm = win_tpl.shape[0]
+    src = idx + jnp.where(idx > p, patch.shift, 0)
+    base = win_tpl[jnp.clip(src, 0, Jm - 1)]
+    base = jnp.where(idx == p - 1, patch.bases[0], base)
+    base = jnp.where(idx == p, patch.bases[1], base)
+    return base
+
+
+def _virtual_trans(win_trans, p, patch: MutationPatch, idx):
+    Jm = win_trans.shape[0]
+    idx = jnp.asarray(idx)
+    src = idx + jnp.where(idx > p, patch.shift, 0)
+    t = win_trans[jnp.clip(src, 0, Jm - 1)]
+    cond0 = jnp.expand_dims(idx == p - 1, -1) if idx.ndim else (idx == p - 1)
+    cond1 = jnp.expand_dims(idx == p, -1) if idx.ndim else (idx == p)
+    t = jnp.where(cond0, patch.trans[0], t)
+    t = jnp.where(cond1, patch.trans[1], t)
+    return t
+
+
+def extend_link_score(read, read_len, win_tpl, win_trans, win_len,
+                      alpha: BandedMatrix, beta: BandedMatrix,
+                      alpha_prefix, beta_suffix,
+                      p, mtype, patch: MutationPatch,
+                      pr_miscall: float = MISMATCH_PROBABILITY):
+    """Absolute log-likelihood of this read under the virtually mutated
+    window template, for an *interior* mutation (3 <= p, end <= J-3).
+
+    read: (Imax,) int32; win_tpl: (Jmax,) int32; win_trans: (Jmax, 4).
+    alpha/beta: saved banded matrices on the unmutated window.
+    alpha_prefix[k] = sum of alpha log-scales for columns < k.
+    beta_suffix[k]  = sum of beta  log-scales for columns >= k.
+    p: oriented window-frame mutation start; mtype: SUB/INS/DEL.
+
+    Parity: MutationScorer::ScoreMutation mid-template branch
+    (MutationScorer.cpp:191-206) = ExtendAlpha(2 cols) + LinkAlphaBeta.
+    """
+    W = alpha.width
+    Imax = read.shape[0]
+    eps = pr_miscall
+    em_hit, em_miss = 1.0 - eps, eps / 3.0
+
+    I = jnp.asarray(read_len, jnp.int32)
+    J = jnp.asarray(win_len, jnp.int32)
+    ld = jnp.where(mtype == INS, 1, jnp.where(mtype == DEL, -1, 0))
+    mend = p + jnp.where(mtype == INS, 0, 1)
+
+    s = jnp.where(mtype == DEL, p - 1, p)   # first recomputed DP column
+    max_left = J + ld                        # virtual template length
+    max_down = I
+
+    beta_link_col = 1 + mend
+    abs_col = beta_link_col + ld
+
+    vb = lambda i: _virtual_base(win_tpl, p, patch, i)
+    vt = lambda i: _virtual_trans(win_trans, p, patch, i)
+
+    def fill_col(prev_vals, prev_off, j):
+        """One ExtendAlpha column at virtual DP column j (template pos j-1)."""
+        o = alpha.offsets[jnp.clip(j, 0, alpha.offsets.shape[0] - 1)]
+        rows = o + jnp.arange(W, dtype=jnp.int32)
+        rbase = jnp.take(read, jnp.clip(rows - 1, 0, Imax - 1))
+        cur_b = vb(j - 1)
+        prev_tr = vt(j - 2)
+        cur_tr = vt(j - 1)
+        next_b = vb(j)
+
+        in_read = (rows >= 1) & (rows <= I)
+        em = jnp.where(rbase == cur_b, em_hit, em_miss)
+        pm1 = _gather_band(prev_vals, prev_off, rows - 1)
+        p0 = _gather_band(prev_vals, prev_off, rows)
+
+        generic = (rows < max_down) & (j < max_left)
+        pinned = (rows == max_down) & (j == max_left)
+        mfac = jnp.where(generic, prev_tr[TRANS_MATCH], jnp.where(pinned, 1.0, 0.0))
+        # (1,1) start case never occurs for interior mutations (s >= 2).
+        b = pm1 * em * mfac
+        b = b + jnp.where((j > 1) & (j < max_left) & (rows != max_down),
+                          p0 * prev_tr[TRANS_DARK], 0.0)
+        b = jnp.where(in_read, b, 0.0)
+
+        ins_em = jnp.where(rbase == next_b, cur_tr[TRANS_BRANCH], cur_tr[TRANS_STICK] / 3.0)
+        c = jnp.where(in_read & (rows > 1) & (rows < max_down) & (j != max_left), ins_em, 0.0)
+        return _affine_scan(b, c), o
+
+    a_prev = alpha.vals[jnp.clip(s - 1, 0, alpha.vals.shape[0] - 1)]
+    a_prev_off = alpha.offsets[jnp.clip(s - 1, 0, alpha.offsets.shape[0] - 1)]
+    ext0, o0 = fill_col(a_prev, a_prev_off, s)
+    ext1, o1 = fill_col(ext0, o0, s + 1)
+
+    # LinkAlphaBeta (SimpleRecursor.cpp:306-357): stitch ext1 (virtual column
+    # s+1 = absolute link col - 1) to beta columns beta_link_col / +1.
+    rows = o1 + jnp.arange(W, dtype=jnp.int32)          # row ids i
+    link_tr = vt(abs_col - 2)
+    link_b = vb(abs_col - 1)
+    rbase_next = jnp.take(read, jnp.clip(rows, 0, Imax - 1))  # read base i+1
+    em_link = jnp.where(rbase_next == link_b, em_hit, em_miss)
+
+    bcol_vals = beta.vals[jnp.clip(beta_link_col, 0, beta.vals.shape[0] - 1)]
+    bcol_off = beta.offsets[jnp.clip(beta_link_col, 0, beta.offsets.shape[0] - 1)]
+    beta_ip1 = _gather_band(bcol_vals, bcol_off, rows + 1)
+    beta_i = _gather_band(bcol_vals, bcol_off, rows)
+
+    match_term = jnp.where(rows < I, ext1 * link_tr[TRANS_MATCH] * em_link * beta_ip1, 0.0)
+    del_term = ext1 * link_tr[TRANS_DARK] * beta_i
+    v = jnp.sum(match_term + del_term)
+
+    n_cols = alpha.log_scales.shape[0]
+    apre = alpha_prefix[jnp.clip(s, 0, n_cols)]
+    bsuf = beta_suffix[jnp.clip(beta_link_col, 0, n_cols)]
+    return jnp.log(jnp.maximum(v, _TINY)) + apre + bsuf
+
+
+def mutated_window(win_tpl, win_trans, win_len, p, mtype, patch: MutationPatch):
+    """Materialize the mutated window (bases, trans, new_len) for the
+    full-refill path (edge mutations)."""
+    Jm = win_tpl.shape[0]
+    idx = jnp.arange(Jm, dtype=jnp.int32)
+    bases = _virtual_base(win_tpl, p, patch, idx)
+    trans = _virtual_trans(win_trans, p, patch, idx)
+    ld = jnp.where(mtype == INS, 1, jnp.where(mtype == DEL, -1, 0))
+    new_len = win_len + ld
+    valid = idx < new_len
+    bases = jnp.where(valid, bases, 4)
+    trans = jnp.where(valid[:, None] & (idx[:, None] < new_len - 1), trans, 0.0)
+    return bases.astype(jnp.int8), trans, new_len
+
+
+def full_refill_score(read, read_len, win_tpl, win_trans, win_len,
+                      p, mtype, patch: MutationPatch, width: int,
+                      pr_miscall: float = MISMATCH_PROBABILITY):
+    """Absolute LL of the mutated window via a full banded forward — the
+    reference's atBegin/atEnd/tiny-template branches (MutationScorer.cpp:
+    208-258) unified into one batched fallback."""
+    bases, trans, new_len = mutated_window(win_tpl, win_trans, win_len, p, mtype, patch)
+    alpha = banded_forward(read.astype(jnp.int8), read_len, bases, trans, new_len,
+                           width, pr_miscall)
+    return forward_loglik(alpha, read_len, new_len)
+
+
+def scale_prefix(log_scales):
+    """alpha_prefix[k] = sum(log_scales[:k]); shape (n+1,)."""
+    return jnp.concatenate([jnp.zeros(1), jnp.cumsum(log_scales)])
+
+
+def scale_suffix(log_scales):
+    """beta_suffix[k] = sum(log_scales[k:]); shape (n+1,)."""
+    return jnp.concatenate([jnp.cumsum(log_scales[::-1])[::-1], jnp.zeros(1)])

@@ -1,0 +1,73 @@
+"""Mutation algebra tests (apply/transcript/remap/enumerate), patterned on
+reference TestMutations.cpp / TestMutationEnumerator.cpp."""
+
+import numpy as np
+
+from pbccs_tpu.models.arrow import mutations as M
+from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
+
+
+def test_apply_substitution_insertion_deletion():
+    tpl = encode_bases("ACGTACGT")
+    assert decode_bases(M.apply_mutations(tpl, [M.substitution(0, 3)])) == "TCGTACGT"
+    assert decode_bases(M.apply_mutations(tpl, [M.insertion(0, 2)])) == "GACGTACGT"
+    assert decode_bases(M.apply_mutations(tpl, [M.deletion(7)])) == "ACGTACG"
+    # multiple mutations with running offset
+    muts = [M.insertion(2, 0), M.deletion(5), M.substitution(7, 0)]
+    assert decode_bases(M.apply_mutations(tpl, muts)) == "ACAGTAGA"
+
+
+def test_target_to_query_positions():
+    tpl = encode_bases("ACGTACGT")
+    muts = [M.insertion(2, 0), M.deletion(5)]
+    mtp = M.target_to_query_positions(muts, len(tpl))
+    newt = M.apply_mutations(tpl, muts)
+    # slices map correctly: t'[mtp[s]:mtp[e]] == apply(muts in [s,e), t[s:e])
+    assert decode_bases(newt[mtp[0]:mtp[8]]) == decode_bases(newt)
+    assert mtp[0] == 0 and mtp[8] == len(newt)
+    # before the insertion, identity; after the deletion, shifted by 0 net
+    assert mtp[1] == 1
+    assert mtp[7] == 7  # +1 ins, -1 del
+
+
+def test_enumerate_counts():
+    tpl = encode_bases("ACGT")
+    # all: 3 subs + 4 ins + 1 del per position
+    assert len(M.enumerate_all(tpl)) == 8 * 4
+    # unique on a non-homopolymer: first pos 3+4+1, later 3+3+1
+    tpl2 = encode_bases("AAC")
+    u = M.enumerate_unique(tpl2)
+    # pos0: 3 subs + 4 ins (prev=-1) + 1 del = 8
+    # pos1: 3 subs + 3 ins (no A) + 0 del (prev==A) = 6
+    # pos2: 3 subs + 3 ins (no A) + 1 del = 7
+    assert len(u) == 8 + 6 + 7
+
+
+def test_best_subset_separation():
+    sm = [M.substitution(10, 0).with_score(5.0),
+          M.substitution(12, 1).with_score(4.0),
+          M.substitution(30, 2).with_score(3.0)]
+    out = M.best_subset(sm, 10)
+    assert {m.start for m in out} == {10, 30}
+
+
+def test_oriented_mutation_roundtrip():
+    # forward: simple translation
+    m = M.substitution(15, 2)
+    om = M.oriented_mutation(m, 0, 10, 40)
+    assert (om.start, om.end, om.new_base) == (5, 6, 2)
+    # reverse: flipped and complemented
+    om = M.oriented_mutation(m, 1, 10, 40)
+    assert (om.start, om.end) == (40 - 16, 40 - 15)
+    assert om.new_base == 1  # G -> C
+    # insertion on reverse strand
+    mi = M.insertion(20, 0)
+    omi = M.oriented_mutation(mi, 1, 10, 40)
+    assert (omi.start, omi.end, omi.new_base) == (20, 20, 3)
+
+
+def test_read_scores_mutation_overlap():
+    assert M.read_scores_mutation(M.substitution(5, 0), 0, 10)
+    assert not M.read_scores_mutation(M.substitution(10, 0), 0, 10)
+    # insertion exactly at window end still scores (<=)
+    assert M.read_scores_mutation(M.insertion(10, 0), 0, 10)
