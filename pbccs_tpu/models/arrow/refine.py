@@ -1,0 +1,80 @@
+"""Consensus refinement: iterative greedy mutation testing.
+
+Host-driven outer loop (the mutation choice is sequential and data-dependent)
+around batched device scoring rounds -- the TPU shape of the reference's
+AbstractRefineConsensus (reference ConsensusCore/include/ConsensusCore/
+Consensus-inl.hpp:160-245) with identical selection semantics: favorable =
+score > 0, greedy well-separated best subset, template-hash cycle avoidance,
+neighborhood re-scans after round 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.models.arrow.scorer import ArrowMultiReadScorer
+
+
+@dataclasses.dataclass(frozen=True)
+class RefineOptions:
+    """Defaults: reference Consensus.hpp:55-61."""
+
+    max_iterations: int = 40
+    mutation_separation: int = 10
+    mutation_neighborhood: int = 20
+
+
+@dataclasses.dataclass
+class RefineResult:
+    converged: bool
+    n_tested: int = 0
+    n_applied: int = 0
+    iterations: int = 0
+
+
+def refine_consensus(scorer: ArrowMultiReadScorer,
+                     opts: RefineOptions | None = None) -> RefineResult:
+    """Iteratively apply favorable mutations until none remain (converged)
+    or the iteration budget runs out (non-convergent)."""
+    opts = opts or RefineOptions()
+    res = RefineResult(converged=False)
+    tpl_history: set[int] = set()
+    favorable: list[mutlib.Mutation] = []
+
+    for it in range(opts.max_iterations):
+        res.iterations = it + 1
+        if it == 0:
+            muts = mutlib.enumerate_unique(scorer.tpl)
+        else:
+            muts = mutlib.unique_nearby_mutations(scorer.tpl, favorable,
+                                                  opts.mutation_neighborhood)
+        res.n_tested += len(muts)
+        scores = scorer.score_mutations(muts)
+        favorable = [m.with_score(s) for m, s in zip(muts, scores) if s > 0.0]
+        if not favorable:
+            res.converged = True
+            break
+
+        best = mutlib.best_subset(favorable, opts.mutation_separation)
+
+        # cycle avoidance (Consensus-inl.hpp:229-241)
+        if len(best) > 1:
+            next_tpl = mutlib.apply_mutations(scorer.tpl, best)
+            if hash(next_tpl.tobytes()) in tpl_history:
+                best = [max(best, key=lambda m: m.score)]
+
+        res.n_applied += len(best)
+        tpl_history.add(hash(scorer.tpl.tobytes()))
+        scorer.apply_mutations(best)
+
+    return res
+
+
+def predicted_accuracy(qvs: np.ndarray) -> float:
+    """1 - mean per-base error probability (reference Consensus.h:506-512)."""
+    if len(qvs) == 0:
+        return 0.0
+    return float(1.0 - np.power(10.0, qvs / -10.0).mean())

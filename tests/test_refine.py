@@ -1,0 +1,49 @@
+"""End-to-end polish test: from a corrupted draft, refinement must recover
+the true template and emit sensible QVs (the reference validates consensus
+recovery in TestPoaConsensus.cpp / integration; here the polish stage alone
+is driven from a known-corrupted draft)."""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.models.arrow import mutations as M
+from pbccs_tpu.models.arrow.params import ArrowConfig, BandingOptions, decode_bases
+from pbccs_tpu.models.arrow.refine import RefineOptions, predicted_accuracy, refine_consensus
+from pbccs_tpu.models.arrow.scorer import ArrowMultiReadScorer
+from pbccs_tpu.simulate import simulate_zmw
+
+
+def corrupt(rng, tpl, n_errors):
+    out = list(tpl)
+    for _ in range(n_errors):
+        kind = rng.integers(0, 3)
+        pos = int(rng.integers(1, len(out) - 1))
+        if kind == 0:
+            out[pos] = (out[pos] + 1 + rng.integers(0, 3)) % 4
+        elif kind == 1:
+            out.insert(pos, rng.integers(0, 4))
+        else:
+            del out[pos]
+    return np.asarray(out, dtype=np.int8)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_refine_recovers_template(seed):
+    rng = np.random.default_rng(800 + seed)
+    L = 60
+    tpl, reads, strands, snr = simulate_zmw(rng, L, 10)
+    draft = corrupt(rng, tpl, 3)
+    width = max(len(r) for r in reads) + 12
+    cfg = ArrowConfig(banding=BandingOptions(band_width=width))
+    sc = ArrowMultiReadScorer(draft, snr, reads, strands,
+                              [0] * len(reads), [len(draft)] * len(reads),
+                              config=cfg, min_zscore=-5.0)
+    res = refine_consensus(sc)
+    assert res.converged
+    assert decode_bases(sc.tpl) == decode_bases(tpl), (
+        decode_bases(sc.tpl), decode_bases(tpl))
+
+    qvs = sc.consensus_qvs()
+    assert len(qvs) == len(tpl)
+    acc = predicted_accuracy(qvs)
+    assert acc > 0.95, acc
