@@ -1,0 +1,73 @@
+"""SparsePoa equivalent: orientation handling + consensus + per-read extents.
+
+Parity: reference src/SparsePoa.cpp:96-199 / include/pacbio/ccs/SparsePoa.h.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from pbccs_tpu.models.arrow.params import revcomp
+from pbccs_tpu.poa.graph import PoaGraph
+
+
+@dataclasses.dataclass
+class PoaAlignmentSummary:
+    """Reference SparsePoa.h:71-86."""
+
+    reverse_complemented: bool = False
+    extent_on_read: tuple[int, int] = (0, 0)
+    extent_on_consensus: tuple[int, int] = (0, 0)
+
+
+class SparsePoa:
+    def __init__(self):
+        self.graph = PoaGraph()
+        self.read_paths: list[list[int]] = []
+        self.reverse_complemented: list[bool] = []
+
+    def orient_and_add_read(self, read: np.ndarray, min_score_to_add: float = 0.0) -> int:
+        """Try both orientations, commit the better one if it clears the
+        score bar; returns the read key or -1
+        (reference SparsePoa.cpp:96-137)."""
+        if self.graph.n_reads == 0:
+            path = self.graph.add_first_read(read)
+            self.read_paths.append(path)
+            self.reverse_complemented.append(False)
+            return 0
+        fwd = self.graph.try_add_read(read, False)
+        rev = self.graph.try_add_read(revcomp(read), True)
+        plan = fwd if fwd.score >= rev.score else rev
+        if plan.score < min_score_to_add:
+            return -1
+        path = self.graph.commit_add(plan)
+        self.read_paths.append(path)
+        self.reverse_complemented.append(plan.reverse_complemented)
+        return len(self.read_paths) - 1
+
+    def find_consensus(self, min_coverage: int):
+        """Returns (consensus codes, per-read PoaAlignmentSummary list)
+        (reference SparsePoa.cpp:139-199)."""
+        path = self.graph.consensus_path(min_coverage)
+        css = np.asarray([self.graph.base[v] for v in path], np.int8)
+        css_position = {v: i for i, v in enumerate(path)}
+
+        summaries = []
+        for key, read_path in enumerate(self.read_paths):
+            read_s = read_e = css_s = css_e = 0
+            found = False
+            for read_pos, v in enumerate(read_path):
+                if v in css_position:
+                    if not found:
+                        css_s = css_position[v]
+                        read_s = read_pos
+                        found = True
+                    css_e = css_position[v] + 1
+                    read_e = read_pos + 1
+            summaries.append(PoaAlignmentSummary(
+                reverse_complemented=self.reverse_complemented[key],
+                extent_on_read=(read_s, read_e),
+                extent_on_consensus=(css_s, css_e)))
+        return css, summaries
