@@ -86,8 +86,9 @@ def _setup_reads(reads, rlens, strands, tstarts, tends,
 
 
 @jax.jit
-def _zscores(lls, strands, tstarts, tends, trans_f, trans_r, L):
-    """Z-scores over the read's window of the oriented template.
+def _read_moments(strands, tstarts, tends, trans_f, trans_r, L):
+    """Per-read (mu, var) of E[log-lik] over the read's window of the
+    oriented template (closed-form HMM moments, Expectations.hpp:45).
 
     Note: the reference indexes the reverse template's moments with
     forward-frame coordinates (MultiReadMutationScorer.cpp:299-317); we use
@@ -96,16 +97,16 @@ def _zscores(lls, strands, tstarts, tends, trans_f, trans_r, L):
     mean_f, var_f = per_base_mean_and_variance(trans_f)
     mean_r, var_r = per_base_mean_and_variance(trans_r)
 
-    def one(ll, strand, ts, te):
+    def one(strand, ts, te):
         s = jnp.where(strand == 0, ts, L - te)
         e = jnp.where(strand == 0, te, L - ts)
         pos = jnp.arange(trans_f.shape[0])
         m = (pos >= s) & (pos < e - 1)
         mu = jnp.sum(jnp.where(m, jnp.where(strand == 0, mean_f, mean_r), 0.0))
         v = jnp.sum(jnp.where(m, jnp.where(strand == 0, var_f, var_r), 0.0))
-        return (ll - mu) / jnp.sqrt(jnp.maximum(v, 1e-12))
+        return mu, v
 
-    return jax.vmap(one)(lls, strands, tstarts, tends)
+    return jax.vmap(one)(strands, tstarts, tends)
 
 
 @jax.jit
@@ -255,10 +256,14 @@ class ArrowMultiReadScorer:
         mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
         mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
 
+        mu, var = _read_moments(
+            jnp.asarray(self._strands), jnp.asarray(self._tstarts),
+            jnp.asarray(self._tends), self.trans_f, self.trans_r, jnp.int32(L))
+        self._ll_mu = np.asarray(mu, np.float64)
+        self._ll_var = np.asarray(var, np.float64)
+
         if first:
-            z = np.asarray(_zscores(jnp.asarray(ll_b), jnp.asarray(self._strands),
-                                    jnp.asarray(self._tstarts), jnp.asarray(self._tends),
-                                    self.trans_f, self.trans_r, jnp.int32(L)), np.float64)
+            z = (ll_b - self._ll_mu) / np.sqrt(np.maximum(self._ll_var, 1e-12))
             for i in range(self.n_reads):
                 if not mated[i]:
                     self.statuses[i] = ADD_ALPHABETAMISMATCH
@@ -280,6 +285,19 @@ class ArrowMultiReadScorer:
 
     def baseline_total(self) -> float:
         return float(self.baselines[self.active].sum())
+
+    def global_zscore(self) -> float:
+        """Z-score of the summed log-likelihood over all active reads
+        (reference MultiReadMutationScorer::ZScores global statistic,
+        Arrow/MultiReadMutationScorer.hpp:174-263)."""
+        act = self.active
+        if not act.any():
+            return float("nan")
+        var = self._ll_var[act].sum()
+        if var <= 0:
+            return float("nan")
+        ll = self.baselines[act].sum()
+        return float((ll - self._ll_mu[act].sum()) / np.sqrt(var))
 
     def _mutation_arrays(self, muts: Sequence[mutlib.Mutation]):
         L = len(self.tpl)

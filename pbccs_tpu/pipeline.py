@@ -1,0 +1,343 @@
+"""Per-ZMW consensus pipeline: filter -> POA draft -> Arrow polish -> QV.
+
+TPU re-design of the reference's per-ZMW orchestration
+(reference include/pacbio/ccs/Consensus.h:224-555): the same stage boundaries
+and yield gates, but the polish stage is a batched device program and the
+whole pipeline is structured so batches of ZMWs can be bucketed and vmapped
+(see pbccs_tpu.parallel for the sharded batch driver).
+
+Failure accounting matches the reference's eight result categories
+(reference include/pacbio/ccs/Consensus.h:155-208, src/main/ccs.cpp:233-262).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Sequence
+
+import numpy as np
+
+from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
+from pbccs_tpu.models.arrow.refine import (
+    RefineOptions,
+    predicted_accuracy,
+    refine_consensus,
+)
+from pbccs_tpu.models.arrow.scorer import ADD_SUCCESS, ArrowMultiReadScorer
+from pbccs_tpu.poa.sparse import PoaAlignmentSummary, SparsePoa
+
+# Local-context adapter flags (reference pbbam LocalContextFlags; a subread is
+# a full pass iff it is flanked by adapter hits on both sides).
+ADAPTER_BEFORE = 1
+ADAPTER_AFTER = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusSettings:
+    """Pipeline knobs, reference defaults
+    (reference include/pacbio/ccs/Consensus.h:86-111)."""
+
+    max_poa_coverage: int = 1024
+    min_length: int = 10
+    min_passes: int = 3
+    min_snr: float = 4.0  # CLI-level gate in the reference (ccs.cpp:441)
+    min_predicted_accuracy: float = 0.90
+    min_zscore: float = -5.0
+    max_drop_fraction: float = 0.34
+    refine: RefineOptions = dataclasses.field(default_factory=RefineOptions)
+
+
+@dataclasses.dataclass
+class Subread:
+    """One subread of a ZMW (reference ReadType, Consensus.h:115-124)."""
+
+    id: str
+    seq: np.ndarray  # int8 base codes
+    flags: int = ADAPTER_BEFORE | ADAPTER_AFTER
+    read_accuracy: float = 0.8
+
+    @classmethod
+    def from_str(cls, id: str, seq: str, **kw) -> "Subread":
+        return cls(id, encode_bases(seq), **kw)
+
+    @property
+    def is_full_pass(self) -> bool:
+        return bool(self.flags & ADAPTER_BEFORE) and bool(self.flags & ADAPTER_AFTER)
+
+
+@dataclasses.dataclass
+class Chunk:
+    """All subreads of one ZMW (reference ChunkType, Consensus.h:126-133)."""
+
+    id: str
+    reads: list[Subread]
+    snr: np.ndarray  # (4,) per-channel SNR, ACGT order
+
+
+class Failure(enum.Enum):
+    """Yield categories (reference ResultType, Consensus.h:155-208)."""
+
+    SUCCESS = "Success"
+    POOR_SNR = "PoorSNR"
+    NO_SUBREADS = "NoSubreads"
+    TOO_SHORT = "TooShort"
+    TOO_MANY_UNUSABLE = "TooManyUnusable"
+    TOO_FEW_PASSES = "TooFewPasses"
+    NON_CONVERGENT = "NonConvergent"
+    POOR_QUALITY = "PoorQuality"
+    OTHER = "Other"
+
+
+@dataclasses.dataclass
+class ConsensusResult:
+    """One CCS read (reference ConsensusType, Consensus.h:135-153)."""
+
+    id: str
+    sequence: str
+    qvs: np.ndarray
+    num_passes: int
+    predicted_accuracy: float
+    global_zscore: float
+    avg_zscore: float
+    zscores: np.ndarray
+    status_counts: list[int]
+    mutations_tested: int
+    mutations_applied: int
+    snr: np.ndarray
+    elapsed_ms: float
+
+    @property
+    def qualities(self) -> str:
+        """Phred+33 ASCII, clamped to [0, 93]
+        (reference QVsToASCII, Consensus.h:328-339)."""
+        return "".join(chr(min(max(0, int(q)), 93) + 33) for q in self.qvs)
+
+
+@dataclasses.dataclass
+class ResultTally:
+    """Mutable per-batch yield counters + results."""
+
+    results: list[ConsensusResult] = dataclasses.field(default_factory=list)
+    counts: dict[Failure, int] = dataclasses.field(
+        default_factory=lambda: {f: 0 for f in Failure})
+
+    def tally(self, failure: Failure) -> None:
+        self.counts[failure] += 1
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def merge(self, other: "ResultTally") -> None:
+        self.results.extend(other.results)
+        for f, c in other.counts.items():
+            self.counts[f] += c
+
+
+def filter_reads(reads: Sequence[Subread], min_length: int
+                 ) -> list[Subread | None]:
+    """Median-length window filter + full-pass-first priority sort.
+
+    Returns the reads (or None for dropped ones) sorted so that full-pass
+    reads closest to the median length come first.  Parity: reference
+    FilterReads (Consensus.h:224-292): median over full-pass lengths (else
+    the longest read), drop reads >= 2*median, return nothing when the median
+    itself is < min_length.
+    """
+    if not reads:
+        return []
+
+    lengths = [len(r.seq) for r in reads if r.is_full_pass]
+    longest = max(len(r.seq) for r in reads)
+    median = float(np.median(lengths)) if lengths else float(longest)
+    max_len = 2.0 * median
+
+    if median < float(min_length):
+        return []
+
+    def lex_key(r: Subread | None):
+        if r is None:
+            return (-1.0, -1.0)  # sorts last
+        l = float(len(r.seq))
+        v = min(l / median, median / l)
+        return (v, 0.0) if r.is_full_pass else (0.0, v)
+
+    # non-ACGT codes (N / pad) never match in the POA or the HMM and would
+    # desync sequence vs QV lengths downstream; empty reads divide-by-zero
+    # in the sort key; both are unusable
+    kept: list[Subread | None] = [
+        r if 0 < len(r.seq) < max_len and bool((r.seq < 4).all()) else None
+        for r in reads]
+    kept.sort(key=lex_key, reverse=True)
+    return kept
+
+
+def poa_consensus(reads: Sequence[Subread | None], max_poa_coverage: int
+                  ) -> tuple[np.ndarray, list[int], list[PoaAlignmentSummary]]:
+    """Draft consensus via sparse POA.
+
+    Returns (consensus codes, per-read keys (-1 = unadded), summaries).
+    Parity: reference PoaConsensus (Consensus.h:352-390) including the
+    min-coverage equation minCov = 1 if cov < 5 else (cov+1)/2 - 1.
+    """
+    poa = SparsePoa()
+    keys: list[int] = []
+    cov = 0
+    for r in reads:
+        if r is None:
+            keys.append(-1)
+            continue
+        key = poa.orient_and_add_read(r.seq)
+        keys.append(key)
+        if key >= 0:
+            cov += 1
+            if cov >= max_poa_coverage:
+                break
+    min_cov = 1 if cov < 5 else (cov + 1) // 2 - 1
+    css, summaries = poa.find_consensus(min_cov)
+    return css, keys, summaries
+
+
+@dataclasses.dataclass
+class MappedRead:
+    """A subread clipped to its POA extents, oriented onto the draft
+    (reference ExtractMappedRead, Consensus.h:296-325)."""
+
+    id: str
+    seq: np.ndarray
+    strand: int  # 0 = forward, 1 = reverse-complemented
+    tpl_start: int
+    tpl_end: int
+    is_full_pass: bool
+
+
+def extract_mapped_read(read: Subread, summary: PoaAlignmentSummary,
+                        min_length: int) -> MappedRead | None:
+    rs, re_ = summary.extent_on_read
+    ts, te = summary.extent_on_consensus
+    if rs > re_ or re_ - rs < min_length:
+        return None
+    if summary.reverse_complemented:
+        # extents are in oriented-read (revcomp) coordinates; the scorer
+        # aligns the NATIVE read against the reverse-complement template
+        # window tpl_r[L-te : L-ts], whose native-frame slice is below
+        n = len(read.seq)
+        seq = read.seq[n - re_: n - rs]
+        strand = 1
+    else:
+        seq = read.seq[rs:re_]
+        strand = 0
+    return MappedRead(read.id, seq, strand, ts, te, read.is_full_pass)
+
+
+def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
+                  ) -> tuple[Failure, ConsensusResult | None]:
+    """The per-ZMW pipeline (reference Consensus, Consensus.h:396-553)."""
+    settings = settings or ConsensusSettings()
+    t0 = time.monotonic()
+
+    if float(np.min(chunk.snr)) < settings.min_snr:
+        return Failure.POOR_SNR, None
+
+    reads = filter_reads(chunk.reads, settings.min_length)
+    if not reads or all(r is None for r in reads):
+        return Failure.NO_SUBREADS, None
+
+    css, keys, summaries = poa_consensus(reads, settings.max_poa_coverage)
+    if len(css) < settings.min_length:
+        return Failure.TOO_SHORT, None
+
+    # map reads onto the draft
+    mapped: list[MappedRead] = []
+    n_unmappable = 0
+    for r, k in zip(reads, keys):
+        if r is None or k < 0:
+            continue
+        mr = extract_mapped_read(r, summaries[k], settings.min_length)
+        if mr is None:
+            n_unmappable += 1
+            continue
+        mapped.append(mr)
+
+    n_candidates = sum(1 for k in keys if k >= 0)
+    if not mapped:
+        return Failure.NO_SUBREADS, None
+
+    scorer = ArrowMultiReadScorer(
+        css, chunk.snr,
+        [m.seq for m in mapped],
+        [m.strand for m in mapped],
+        [m.tpl_start for m in mapped],
+        [m.tpl_end for m in mapped],
+        min_zscore=settings.min_zscore)
+
+    status_counts = [0] * 5
+    n_passes = 0
+    n_dropped = n_unmappable
+    for i, m in enumerate(mapped):
+        st = int(scorer.statuses[i])
+        status_counts[st] += 1
+        if st == ADD_SUCCESS and m.is_full_pass:
+            n_passes += 1
+        elif st != ADD_SUCCESS:
+            n_dropped += 1
+
+    if n_passes < settings.min_passes:
+        return Failure.TOO_FEW_PASSES, None
+
+    if n_candidates > 0 and n_dropped / n_candidates > settings.max_drop_fraction:
+        return Failure.TOO_MANY_UNUSABLE, None
+
+    # original z-score stats before refinement
+    zs = scorer.zscores[np.isfinite(scorer.zscores)]
+    avg_z = float(zs.mean()) if len(zs) else float("nan")
+    global_z = scorer.global_zscore()
+
+    refine = refine_consensus(scorer, settings.refine)
+    if not refine.converged:
+        return Failure.NON_CONVERGENT, None
+
+    qvs = scorer.consensus_qvs()
+    pred_acc = predicted_accuracy(qvs)
+    if pred_acc < settings.min_predicted_accuracy:
+        return Failure.POOR_QUALITY, None
+
+    sequence = decode_bases(scorer.tpl)
+    if len(sequence) != len(qvs):  # invalid bases reached the template
+        return Failure.OTHER, None
+
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    return Failure.SUCCESS, ConsensusResult(
+        id=chunk.id,
+        sequence=sequence,
+        qvs=qvs,
+        num_passes=n_passes,
+        predicted_accuracy=pred_acc,
+        global_zscore=global_z,
+        avg_zscore=avg_z,
+        zscores=scorer.zscores.copy(),
+        status_counts=status_counts,
+        mutations_tested=refine.n_tested,
+        mutations_applied=refine.n_applied,
+        snr=np.asarray(chunk.snr),
+        elapsed_ms=elapsed_ms)
+
+
+def process_chunks(chunks: Sequence[Chunk],
+                   settings: ConsensusSettings | None = None) -> ResultTally:
+    """Process a batch of ZMWs; exceptions become Other tallies and the batch
+    continues (reference Consensus.h:543-548)."""
+    settings = settings or ConsensusSettings()
+    tally = ResultTally()
+    for chunk in chunks:
+        try:
+            failure, result = process_chunk(chunk, settings)
+        except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+            tally.tally(Failure.OTHER)
+            continue
+        tally.tally(failure)
+        if result is not None:
+            tally.results.append(result)
+    return tally
