@@ -58,6 +58,16 @@ def revcomp(codes: np.ndarray) -> np.ndarray:
     return _COMPLEMENT[np.asarray(codes)[::-1]]
 
 
+def revcomp_padded(tpl: "jax.Array", length: "jax.Array") -> "jax.Array":
+    """Jittable reverse complement of the first `length` entries of a padded
+    int8 template; the tail stays padding (code 4)."""
+    Jmax = tpl.shape[0]
+    idx = length - 1 - jnp.arange(Jmax, dtype=jnp.int32)
+    comp = jnp.asarray(_COMPLEMENT)
+    vals = comp[jnp.take(tpl, jnp.clip(idx, 0, Jmax - 1)).astype(jnp.int32)]
+    return jnp.where(idx >= 0, vals, 4).astype(jnp.int8)
+
+
 # Transition-probability channel order used framework-wide.
 TRANS_MATCH, TRANS_BRANCH, TRANS_STICK, TRANS_DARK = 0, 1, 2, 3
 

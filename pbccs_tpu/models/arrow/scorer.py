@@ -60,51 +60,67 @@ def _next_pow2(n: int, lo: int = 8) -> int:
     return v
 
 
+def oriented_window_fill(read, rlen, strand, ts, te,
+                         tpl_f, trans_f, tpl_r, trans_r, L, width: int):
+    """Build one read's oriented template window and fill its alpha/beta.
+
+    Returns (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
+    alpha_scale_prefix, beta_scale_suffix).  Shared by the per-ZMW scorer and
+    the batched ZMW driver (pbccs_tpu.parallel.batch)."""
+    Jmax = tpl_f.shape[0]
+    ws = jnp.where(strand == 0, ts, L - te)
+    wlen = te - ts
+    idx = jnp.arange(Jmax, dtype=jnp.int32)
+    src = jnp.clip(ws + idx, 0, Jmax - 1)
+    base = jnp.where(strand == 0, tpl_f[src], tpl_r[src])
+    trans = jnp.where(strand == 0, trans_f[src], trans_r[src])
+    win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
+    win_trans = jnp.where((idx < wlen - 1)[:, None], trans, 0.0)
+    alpha = banded_forward(read, rlen, win_tpl, win_trans, wlen, width)
+    beta = banded_backward(read, rlen, win_tpl, win_trans, wlen, width)
+    ll_a = forward_loglik(alpha, rlen, wlen)
+    ll_b = backward_loglik(beta, wlen)
+    return (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
+            scale_prefix(alpha.log_scales), scale_suffix(beta.log_scales))
+
+
 @functools.partial(jax.jit, static_argnames=("width",))
 def _setup_reads(reads, rlens, strands, tstarts, tends,
                  tpl_f, trans_f, tpl_r, trans_r, L, width: int):
     """Build per-read oriented windows and fill alpha/beta for each read."""
-    Jmax = tpl_f.shape[0]
 
     def one(read, rlen, strand, ts, te):
-        ws = jnp.where(strand == 0, ts, L - te)
-        wlen = te - ts
-        idx = jnp.arange(Jmax, dtype=jnp.int32)
-        src = jnp.clip(ws + idx, 0, Jmax - 1)
-        base = jnp.where(strand == 0, tpl_f[src], tpl_r[src])
-        trans = jnp.where(strand == 0, trans_f[src], trans_r[src])
-        win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
-        win_trans = jnp.where((idx < wlen - 1)[:, None], trans, 0.0)
-        alpha = banded_forward(read, rlen, win_tpl, win_trans, wlen, width)
-        beta = banded_backward(read, rlen, win_tpl, win_trans, wlen, width)
-        ll_a = forward_loglik(alpha, rlen, wlen)
-        ll_b = backward_loglik(beta, wlen)
-        return (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
-                scale_prefix(alpha.log_scales), scale_suffix(beta.log_scales))
+        return oriented_window_fill(read, rlen, strand, ts, te,
+                                    tpl_f, trans_f, tpl_r, trans_r, L, width)
 
     return jax.vmap(one)(reads, rlens, strands, tstarts, tends)
 
 
-@jax.jit
-def _read_moments(strands, tstarts, tends, trans_f, trans_r, L):
-    """Per-read (mu, var) of E[log-lik] over the read's window of the
-    oriented template (closed-form HMM moments, Expectations.hpp:45).
+def window_moments(strand, ts, te, mean_f, var_f, mean_r, var_r, L):
+    """(mu, var) of E[log-lik] over one read's window of the oriented
+    template (closed-form HMM moments, Expectations.hpp:45).
 
     Note: the reference indexes the reverse template's moments with
     forward-frame coordinates (MultiReadMutationScorer.cpp:299-317); we use
     the read's actual window on the oriented template, which is the intended
     statistic (documented deviation)."""
+    s = jnp.where(strand == 0, ts, L - te)
+    e = jnp.where(strand == 0, te, L - ts)
+    pos = jnp.arange(mean_f.shape[0])
+    m = (pos >= s) & (pos < e - 1)
+    mu = jnp.sum(jnp.where(m, jnp.where(strand == 0, mean_f, mean_r), 0.0))
+    v = jnp.sum(jnp.where(m, jnp.where(strand == 0, var_f, var_r), 0.0))
+    return mu, v
+
+
+@jax.jit
+def _read_moments(strands, tstarts, tends, trans_f, trans_r, L):
+    """Per-read (mu, var) over each read's oriented window."""
     mean_f, var_f = per_base_mean_and_variance(trans_f)
     mean_r, var_r = per_base_mean_and_variance(trans_r)
 
     def one(strand, ts, te):
-        s = jnp.where(strand == 0, ts, L - te)
-        e = jnp.where(strand == 0, te, L - ts)
-        pos = jnp.arange(trans_f.shape[0])
-        m = (pos >= s) & (pos < e - 1)
-        mu = jnp.sum(jnp.where(m, jnp.where(strand == 0, mean_f, mean_r), 0.0))
-        v = jnp.sum(jnp.where(m, jnp.where(strand == 0, var_f, var_r), 0.0))
-        return mu, v
+        return window_moments(strand, ts, te, mean_f, var_f, mean_r, var_r, L)
 
     return jax.vmap(one)(strands, tstarts, tends)
 
@@ -113,6 +129,25 @@ def _read_moments(strands, tstarts, tends, trans_f, trans_r, L):
 def _make_patches(tpl, trans, trans_table, L, pos, mtype, new_base):
     return jax.vmap(lambda p, t, b: make_patch(tpl, trans, trans_table, L, p, t, b))(
         pos, mtype, new_base)
+
+
+def interior_read_scores(read, rlen, strand, ts, te, wt, wtr, wl,
+                         alpha, beta, apre, bsuf,
+                         mpos_f, mend_f, mtype,
+                         patches_f: MutationPatch, patches_r: MutationPatch):
+    """(M,) absolute mutated-template log-likelihoods of one read via
+    extend+link, given forward-frame mutation arrays + fwd/rev patches."""
+    read32 = read.astype(jnp.int32)
+    wt32 = wt.astype(jnp.int32)
+
+    def per_mut(pf, ef, mt, patf, patr):
+        p = jnp.where(strand == 0, pf - ts, te - ef)
+        patch = jax.tree.map(lambda a, b: jnp.where(strand == 0, a, b), patf, patr)
+        return extend_link_score(read32, rlen, wt32, wtr, wl,
+                                 alpha, beta, apre, bsuf,
+                                 p, mt, patch)
+
+    return jax.vmap(per_mut)(mpos_f, mend_f, mtype, patches_f, patches_r)
 
 
 @jax.jit
@@ -128,19 +163,10 @@ def _score_interior(reads, rlens, strands, tstarts, tends,
 
     def per_read(read, rlen, strand, ts, te, wt, wtr, wl,
                  av, ao, als, bv, bo, bls, apre, bsuf):
-        alpha = BandedMatrix(av, ao, als)
-        beta = BandedMatrix(bv, bo, bls)
-        read32 = read.astype(jnp.int32)
-        wt32 = wt.astype(jnp.int32)
-
-        def per_mut(pf, ef, mt, patf, patr):
-            p = jnp.where(strand == 0, pf - ts, te - ef)
-            patch = jax.tree.map(lambda a, b: jnp.where(strand == 0, a, b), patf, patr)
-            return extend_link_score(read32, rlen, wt32, wtr, wl,
-                                     alpha, beta, apre, bsuf,
-                                     p, mt, patch)
-
-        return jax.vmap(per_mut)(mpos_f, mend_f, mtype, patches_f, patches_r)
+        return interior_read_scores(
+            read, rlen, strand, ts, te, wt, wtr, wl,
+            BandedMatrix(av, ao, als), BandedMatrix(bv, bo, bls), apre, bsuf,
+            mpos_f, mend_f, mtype, patches_f, patches_r)
 
     return jax.vmap(per_read)(reads, rlens, strands, tstarts, tends,
                               win_tpl, win_trans, wlens,

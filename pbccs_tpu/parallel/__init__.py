@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: batched ZMW polishing sharded over TPU cores.
+
+The algorithm has no cross-ZMW coupling (reference parallelism is a
+thread-per-ZMW WorkQueue, include/pacbio/ccs/WorkQueue.h:53-217), so the
+distribution story is:
+
+  * `zmw` mesh axis  -- data parallelism over the ZMW batch dimension
+  * `read` mesh axis -- intra-ZMW parallelism over subreads; mutation-score
+    totals reduce over this axis, so XLA inserts an all-reduce across it
+    (the analogue of tensor parallelism's psum)
+
+Both axes ride ICI inside a pod slice; scale-out across hosts shards BAM
+chunks over DCN (pure data parallelism, no collectives required).
+"""
+
+from pbccs_tpu.parallel.mesh import make_zmw_mesh, shard_batch
+from pbccs_tpu.parallel.batch import BatchPolisher
+
+__all__ = ["make_zmw_mesh", "shard_batch", "BatchPolisher"]

@@ -1,0 +1,523 @@
+"""Batched ZMW polishing: many ZMWs per device program, sharded over a mesh.
+
+This is the TPU replacement for the reference's one-thread-per-ZMW WorkQueue
+(reference include/pacbio/ccs/WorkQueue.h:53-217) *and* the per-ZMW serial
+mutation-testing loop (reference ConsensusCore/include/ConsensusCore/
+Consensus-inl.hpp:160-245): Z bucketed ZMWs advance through the refinement
+loop in lockstep, each round being one jitted batched program over the
+(ZMW, read, mutation) grid.  Mutation-score totals reduce over the read
+axis, so sharding reads across the 'read' mesh axis makes XLA insert the
+all-reduce; the ZMW axis is pure data parallelism.
+
+Selection semantics per ZMW are identical to the host refinement loop
+(models/arrow/refine.py): favorable = score > 0, greedy well-separated best
+subset, template-hash cycle avoidance, converged ZMWs drop out of the
+mutation workload (their slots are masked, not recompiled away).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
+from pbccs_tpu.models.arrow.params import (
+    ArrowConfig,
+    revcomp_padded,
+    snr_to_transition_table,
+    template_transition_params,
+)
+from pbccs_tpu.models.arrow.refine import RefineOptions, RefineResult
+from pbccs_tpu.models.arrow.scorer import (
+    ADD_ALPHABETAMISMATCH,
+    ADD_POOR_ZSCORE,
+    ADD_SUCCESS,
+    _AB_MISMATCH_TOL,
+    interior_read_scores,
+    oriented_window_fill,
+    window_moments,
+)
+from pbccs_tpu.ops.fwdbwd import BandedMatrix
+from pbccs_tpu.ops.mutation_score import (
+    INS,
+    SUB,
+    MutationPatch,
+    full_refill_score,
+    make_patch,
+)
+from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
+
+# mutation-axis chunk: every scoring call uses this static M so one compiled
+# program serves every refinement round and the QV sweep
+MUT_CHUNK = 512
+
+
+@dataclasses.dataclass
+class ZmwTask:
+    """One ZMW's polish-stage inputs (draft template + mapped reads)."""
+
+    id: str
+    tpl: np.ndarray           # (L,) int8 draft consensus
+    snr: np.ndarray           # (4,)
+    reads: Sequence[np.ndarray]
+    strands: Sequence[int]
+    tstarts: Sequence[int]
+    tends: Sequence[int]
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _batch_setup(tpls, tlens, snrs, reads, rlens, strands, tstarts, tends,
+                 width: int):
+    """Per-ZMW template tracks + per-read window fills + moments.
+
+    All leading axes are (Z, ...) with reads (Z, R, Imax)."""
+
+    def one_zmw(tpl, L, snr, reads1, rlens1, st1, ts1, te1):
+        table = snr_to_transition_table(snr)
+        trans_f = template_transition_params(tpl, table, L)
+        tpl_r = revcomp_padded(tpl, L)
+        trans_r = template_transition_params(tpl_r, table, L)
+
+        def one_read(read, rlen, strand, ts, te):
+            return oriented_window_fill(read, rlen, strand, ts, te,
+                                        tpl, trans_f, tpl_r, trans_r, L, width)
+
+        fills = jax.vmap(one_read)(reads1, rlens1, st1, ts1, te1)
+
+        mean_f, var_f = per_base_mean_and_variance(trans_f)
+        mean_r, var_r = per_base_mean_and_variance(trans_r)
+        mu, var = jax.vmap(
+            lambda s, a, b: window_moments(s, a, b, mean_f, var_f, mean_r, var_r, L)
+        )(st1, ts1, te1)
+
+        return fills + (trans_f, tpl_r, trans_r, table, mu, var)
+
+    return jax.vmap(one_zmw)(tpls, tlens, snrs, reads, rlens,
+                             strands, tstarts, tends)
+
+
+@jax.jit
+def _batch_patches(tpl32, trans, table, L, pos, mtype, base):
+    """(Z, M) virtual-mutation patches on one oriented template track."""
+
+    def one_zmw(t, tr, tb, l, p1, mt1, b1):
+        return jax.vmap(lambda p, mt, b: make_patch(t, tr, tb, l, p, mt, b))(
+            p1, mt1, b1)
+
+    return jax.vmap(one_zmw)(tpl32, trans, table, L, pos, mtype, base)
+
+
+@jax.jit
+def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
+                           win_tpl, win_trans, wlens,
+                           alpha_vals, alpha_offs, alpha_ls,
+                           beta_vals, beta_offs, beta_ls,
+                           a_prefix, b_suffix, baselines,
+                           mpos_f, mend_f, mtype,
+                           patches_f: MutationPatch, patches_r: MutationPatch,
+                           int_mask):
+    """(Z, M) = sum over reads of masked (LL(mut) - baseline).
+
+    The read-axis reduction is the collective: with reads sharded over the
+    'read' mesh axis XLA lowers the sum to an all-reduce over ICI."""
+
+    def one_zmw(read1, rlen1, st1, ts1, te1, wt1, wtr1, wl1,
+                av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1,
+                mp1, me1, mt1, pf1, pr1, mask1):
+        def one_read(read, rlen, strand, ts, te, wt, wtr, wl,
+                     av, ao, als, bv, bo, bls, apre, bsuf, bl, mask):
+            lls = interior_read_scores(
+                read, rlen, strand, ts, te, wt, wtr, wl,
+                BandedMatrix(av, ao, als), BandedMatrix(bv, bo, bls),
+                apre, bsuf, mp1, me1, mt1, pf1, pr1)
+            return jnp.where(mask, lls - bl, 0.0)
+
+        per_read = jax.vmap(one_read)(
+            read1, rlen1, st1, ts1, te1, wt1, wtr1, wl1,
+            av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1, mask1)
+        return jnp.sum(per_read, axis=0)
+
+    return jax.vmap(one_zmw)(reads, rlens, strands, tstarts, tends,
+                             win_tpl, win_trans, wlens,
+                             alpha_vals, alpha_offs, alpha_ls,
+                             beta_vals, beta_offs, beta_ls,
+                             a_prefix, b_suffix, baselines,
+                             mpos_f, mend_f, mtype,
+                             patches_f, patches_r, int_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _batch_edge(reads, rlens, win_tpl, win_trans, wlens,
+                zidx, ridx, pw, mt, pb, ptr, psh, width: int):
+    """(E,) absolute LLs of edge (read, mutation) pairs via full refill."""
+
+    def one(z, r, p, t, b, tr, sh):
+        read = reads[z, r].astype(jnp.int32)
+        return full_refill_score(read, rlens[z, r],
+                                 win_tpl[z, r].astype(jnp.int32),
+                                 win_trans[z, r], wlens[z, r],
+                                 p, t, MutationPatch(b, tr, sh), width)
+
+    return jax.vmap(one)(zidx, ridx, pw, mt, pb, ptr, psh)
+
+
+class BatchPolisher:
+    """Z bucketed ZMWs polished in lockstep on one device mesh.
+
+    Equivalent per-ZMW semantics to models.arrow.scorer.ArrowMultiReadScorer
+    + models.arrow.refine.refine_consensus, with leading (Z,) batch axes and
+    optional ('zmw' x 'read') mesh sharding."""
+
+    def __init__(self, tasks: Sequence[ZmwTask],
+                 config: ArrowConfig | None = None,
+                 min_zscore: float = float("nan"),
+                 mesh: Mesh | None = None):
+        if not tasks:
+            raise ValueError("empty batch")
+        self.config = config or ArrowConfig()
+        self.min_zscore = min_zscore
+        self.mesh = mesh
+        self.n_zmws = len(tasks)
+        self.ids = [t.id for t in tasks]
+        self.tpls: list[np.ndarray] = [np.asarray(t.tpl, np.int8) for t in tasks]
+
+        zq = mesh.shape[ZMW_AXIS] if mesh else 1
+        rq = mesh.shape[READ_AXIS] if mesh else 1
+        self._Z = pad_to(self.n_zmws, zq)
+        self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
+        self._Imax = pad_to(max((len(r) for t in tasks for r in t.reads),
+                                default=8) + 8, 64)
+        self._Jmax = pad_to(max(len(t.tpl) for t in tasks) + 16, 64)
+        self._W = self.config.banding.band_width
+
+        Z, R = self._Z, self._R
+        self._snrs = np.full((Z, 4), 8.0)
+        self._reads = np.full((Z, R, self._Imax), 4, np.int8)
+        self._rlens = np.zeros((Z, R), np.int32)
+        self._strands = np.zeros((Z, R), np.int32)
+        self._tstarts = np.zeros((Z, R), np.int32)
+        self._tends = np.zeros((Z, R), np.int32)
+        self._n_reads = np.zeros(Z, np.int32)
+        for z, t in enumerate(tasks):
+            self._snrs[z] = t.snr
+            self._n_reads[z] = len(t.reads)
+            for i, rc in enumerate(t.reads):
+                n = min(len(rc), self._Imax)
+                self._reads[z, i, :n] = rc[:n]
+                self._rlens[z, i] = n
+            self._strands[z, : len(t.reads)] = t.strands
+            self._tstarts[z, : len(t.reads)] = t.tstarts
+            self._tends[z, : len(t.reads)] = t.tends
+        # padding read rows (and whole padding ZMWs) get a trivial window
+        for z in range(Z):
+            L = len(self.tpls[z]) if z < self.n_zmws else 2
+            nr = int(self._n_reads[z])
+            self._reads[z, nr:, :2] = 0
+            self._rlens[z, nr:] = 2
+            self._tends[z, nr:] = min(2, L)
+
+        self.active = np.zeros((Z, R), bool)
+        self.statuses = np.full((Z, R), -1, np.int32)
+        self.zscores = np.full((Z, R), np.nan)
+        self._setup(first=True)
+
+    # ------------------------------------------------------------------ setup
+
+    def _shard(self, arr, read_axis: int | None = None):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        parts: list = [None] * np.ndim(arr)
+        parts[0] = ZMW_AXIS
+        if read_axis is not None:
+            parts[read_axis] = READ_AXIS
+        return jax.device_put(np.asarray(arr),
+                              NamedSharding(self.mesh, P(*parts)))
+
+    def _template_arrays(self):
+        Z = self._Z
+        tl = np.full((Z, self._Jmax), 4, np.int8)
+        tlens = np.full(Z, 2, np.int32)
+        for z in range(self.n_zmws):
+            L = len(self.tpls[z])
+            if L > self._Jmax:
+                raise ValueError("template outgrew bucket")
+            tl[z, :L] = self.tpls[z]
+            tlens[z] = L
+        return tl, tlens
+
+    def _setup(self, first: bool) -> None:
+        """(Re)build all window fills; gate reads on the first build."""
+        tl, tlens = self._template_arrays()
+        self._tlens = tlens
+        (self.win_tpl, self.win_trans, self.wlens, alpha, beta,
+         ll_a, ll_b, self.a_prefix, self.b_suffix,
+         self.trans_f, self.tpl_r, self.trans_r, self.table,
+         mu, var) = _batch_setup(
+            self._shard(tl), self._shard(tlens), self._shard(self._snrs),
+            self._shard(self._reads, read_axis=1),
+            self._shard(self._rlens, read_axis=1),
+            self._shard(self._strands, read_axis=1),
+            self._shard(self._tstarts, read_axis=1),
+            self._shard(self._tends, read_axis=1),
+            self._W)
+        self.alpha, self.beta = alpha, beta
+        self._tpl_dev = self._shard(tl)
+
+        ll_a = np.asarray(ll_a, np.float64)
+        ll_b = np.asarray(ll_b, np.float64)
+        self.baselines = ll_b
+        self._ll_mu = np.asarray(mu, np.float64)
+        self._ll_var = np.asarray(var, np.float64)
+        mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
+        mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
+
+        real = np.zeros((self._Z, self._R), bool)
+        for z in range(self.n_zmws):
+            real[z, : self._n_reads[z]] = True
+
+        if first:
+            z = (ll_b - self._ll_mu) / np.sqrt(np.maximum(self._ll_var, 1e-12))
+            self.zscores = np.where(real & mated, z, np.nan)
+            ok_z = np.isnan(self.min_zscore) | (
+                np.isfinite(z) & (z >= self.min_zscore))
+            self.active = real & mated & ok_z
+            self.statuses = np.where(
+                ~real, -1,
+                np.where(~mated, ADD_ALPHABETAMISMATCH,
+                         np.where(~ok_z, ADD_POOR_ZSCORE, ADD_SUCCESS)))
+        else:
+            self.active &= mated
+            self.active &= real
+
+    # ---------------------------------------------------------------- scoring
+
+    def _score_chunk(self, pos_f, end_f, mtype, base_f, pos_r, base_r, valid):
+        """Score one (Z, MUT_CHUNK) mutation slab; returns (Z, M) totals."""
+        Z, R = self._Z, self._R
+        Ls = self._tlens.astype(np.int64)
+
+        patches_f = _batch_patches(
+            self._tpl_dev.astype(jnp.int32), self.trans_f, self.table,
+            self._shard(self._tlens), self._shard(pos_f),
+            self._shard(mtype), self._shard(base_f))
+        patches_r = _batch_patches(
+            self.tpl_r.astype(jnp.int32), self.trans_r, self.table,
+            self._shard(self._tlens), self._shard(pos_r),
+            self._shard(mtype), self._shard(base_r))
+
+        # (Z, R, M) host-side classification
+        ts = self._tstarts[:, :, None]
+        te = self._tends[:, :, None]
+        strand = self._strands[:, :, None]
+        ms, me = pos_f[:, None, :], end_f[:, None, :]
+        is_ins = (mtype == INS)[:, None, :]
+        overlap = np.where(is_ins, (ts <= me) & (ms <= te), (ts < me) & (ms < te))
+        p_w = np.where(strand == 0, ms - ts, te - me)
+        e_w = np.where(strand == 0, me - ts, te - ms)
+        wlen = te - ts
+        interior = (p_w >= 3) & (e_w <= wlen - 2)
+        act = self.active[:, :, None] & valid[:, None, :]
+        int_mask = act & overlap & interior
+        edge_mask = act & overlap & ~interior
+
+        totals = np.asarray(_batch_interior_totals(
+            self._shard(self._reads, 1), self._shard(self._rlens, 1),
+            self._shard(self._strands, 1), self._shard(self._tstarts, 1),
+            self._shard(self._tends, 1),
+            self.win_tpl, self.win_trans, self.wlens,
+            self.alpha.vals, self.alpha.offsets, self.alpha.log_scales,
+            self.beta.vals, self.beta.offsets, self.beta.log_scales,
+            self.a_prefix, self.b_suffix, self._shard(self.baselines, 1),
+            self._shard(pos_f), self._shard(end_f), self._shard(mtype),
+            patches_f, patches_r, self._shard(int_mask, 1)), np.float64)
+
+        ez, er, em = np.nonzero(edge_mask)
+        if len(ez):
+            E = len(ez)
+            Epad = pad_to(E, 64)
+            zi = np.zeros(Epad, np.int32)
+            ri = np.zeros(Epad, np.int32)
+            pp = np.zeros(Epad, np.int32)
+            pt = np.zeros(Epad, np.int32)
+            pb = np.zeros((Epad, 2), np.int32)
+            ptr = np.zeros((Epad, 2, 4), np.float32)
+            psh = np.zeros(Epad, np.int32)
+            zi[:E], ri[:E] = ez, er
+            pp[:E] = p_w[ez, er, em]
+            pt[:E] = mtype[ez, em]
+            pf_b = np.asarray(patches_f.bases)
+            pf_t = np.asarray(patches_f.trans)
+            pf_s = np.asarray(patches_f.shift)
+            pr_b = np.asarray(patches_r.bases)
+            pr_t = np.asarray(patches_r.trans)
+            pr_s = np.asarray(patches_r.shift)
+            fwd = self._strands[ez, er] == 0
+            pb[:E] = np.where(fwd[:, None], pf_b[ez, em], pr_b[ez, em])
+            ptr[:E] = np.where(fwd[:, None, None], pf_t[ez, em], pr_t[ez, em])
+            psh[:E] = np.where(fwd, pf_s[ez, em], pr_s[ez, em])
+            edge_ll = np.asarray(_batch_edge(
+                self._shard(self._reads, 1), self._shard(self._rlens, 1),
+                self.win_tpl, self.win_trans, self.wlens,
+                jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
+                jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),
+                jnp.asarray(psh), self._W), np.float64)[:E]
+            np.add.at(totals, (ez, em), edge_ll - self.baselines[ez, er])
+
+        return totals
+
+    def score_mutations(self, muts_per_zmw: Sequence[Sequence[mutlib.Mutation]]
+                        ) -> list[np.ndarray]:
+        """Per-ZMW arrays of summed mutation scores (parity with
+        ArrowMultiReadScorer.score_mutations, batched over Z)."""
+        assert len(muts_per_zmw) == self.n_zmws
+        Z = self._Z
+        Mmax = max((len(m) for m in muts_per_zmw), default=0)
+        if Mmax == 0:
+            return [np.zeros(0) for _ in muts_per_zmw]
+        n_chunks = (Mmax + MUT_CHUNK - 1) // MUT_CHUNK
+        out = [np.zeros(len(m)) for m in muts_per_zmw]
+
+        for c in range(n_chunks):
+            lo = c * MUT_CHUNK
+            pos_f = np.zeros((Z, MUT_CHUNK), np.int32)
+            end_f = np.ones((Z, MUT_CHUNK), np.int32)
+            mtype = np.full((Z, MUT_CHUNK), SUB, np.int32)
+            base_f = np.zeros((Z, MUT_CHUNK), np.int32)
+            pos_r = np.zeros((Z, MUT_CHUNK), np.int32)
+            base_r = np.zeros((Z, MUT_CHUNK), np.int32)
+            valid = np.zeros((Z, MUT_CHUNK), bool)
+            # default dummies sit mid-template to stay interior & cheap
+            for z in range(self.n_zmws):
+                L = len(self.tpls[z])
+                pos_f[z], end_f[z] = L // 2, L // 2 + 1
+                pos_r[z] = L - (L // 2) - 1
+                muts = muts_per_zmw[z][lo: lo + MUT_CHUNK]
+                for k, m in enumerate(muts):
+                    rcm = mutlib.reverse_complement_mutation(m, L)
+                    pos_f[z, k], end_f[z, k] = m.start, m.end
+                    mtype[z, k] = m.mtype
+                    base_f[z, k] = m.new_base
+                    pos_r[z, k] = rcm.start
+                    base_r[z, k] = rcm.new_base
+                    valid[z, k] = True
+            totals = self._score_chunk(pos_f, end_f, mtype, base_f,
+                                       pos_r, base_r, valid)
+            for z in range(self.n_zmws):
+                n = min(len(muts_per_zmw[z]) - lo, MUT_CHUNK)
+                if n > 0:
+                    out[z][lo: lo + n] = totals[z, :n]
+        return out
+
+    # --------------------------------------------------------------- mutation
+
+    def apply_mutations(self, best_per_zmw: Sequence[Sequence[mutlib.Mutation]]
+                        ) -> None:
+        """Splice per-ZMW mutations, remap read windows, rebuild fills."""
+        changed = False
+        for z, best in enumerate(best_per_zmw):
+            if not best:
+                continue
+            changed = True
+            L = len(self.tpls[z])
+            mtp = mutlib.target_to_query_positions(best, L)
+            self.tpls[z] = mutlib.apply_mutations(self.tpls[z], best)
+            self._tstarts[z] = mtp[np.clip(self._tstarts[z], 0, L)]
+            self._tends[z] = mtp[np.clip(self._tends[z], 0, L)]
+        if not changed:
+            return
+        max_l = max(len(t) for t in self.tpls)
+        if max_l + 2 > self._Jmax:
+            self._Jmax = pad_to(max_l + 16, 64)  # rebucket (recompiles)
+        self._setup(first=False)
+
+    # ------------------------------------------------------------- refinement
+
+    def refine(self, opts: RefineOptions | None = None) -> list[RefineResult]:
+        """Lockstep greedy refinement across the batch."""
+        opts = opts or RefineOptions()
+        Z = self.n_zmws
+        results = [RefineResult(converged=False) for _ in range(Z)]
+        history: list[set[int]] = [set() for _ in range(Z)]
+        favorable: list[list[mutlib.Mutation]] = [[] for _ in range(Z)]
+        done = np.zeros(Z, bool)
+
+        for it in range(opts.max_iterations):
+            muts_per_zmw: list[list[mutlib.Mutation]] = []
+            for z in range(Z):
+                if done[z]:
+                    muts_per_zmw.append([])
+                elif it == 0:
+                    muts_per_zmw.append(mutlib.enumerate_unique(self.tpls[z]))
+                else:
+                    muts_per_zmw.append(mutlib.unique_nearby_mutations(
+                        self.tpls[z], favorable[z], opts.mutation_neighborhood))
+            if all(done):
+                break
+            scores = self.score_mutations(muts_per_zmw)
+
+            best_per_zmw: list[list[mutlib.Mutation]] = []
+            for z in range(Z):
+                if done[z]:
+                    best_per_zmw.append([])
+                    continue
+                results[z].iterations = it + 1
+                results[z].n_tested += len(muts_per_zmw[z])
+                fav = [m.with_score(s)
+                       for m, s in zip(muts_per_zmw[z], scores[z]) if s > 0.0]
+                favorable[z] = fav
+                if not fav:
+                    results[z].converged = True
+                    done[z] = True
+                    best_per_zmw.append([])
+                    continue
+                best = mutlib.best_subset(fav, opts.mutation_separation)
+                if len(best) > 1:
+                    nxt = mutlib.apply_mutations(self.tpls[z], best)
+                    if hash(nxt.tobytes()) in history[z]:
+                        best = [max(best, key=lambda m: m.score)]
+                history[z].add(hash(self.tpls[z].tobytes()))
+                results[z].n_applied += len(best)
+                best_per_zmw.append(best)
+
+            self.apply_mutations(best_per_zmw)
+
+        return results
+
+    # ------------------------------------------------------------------- QVs
+
+    def consensus_qvs(self) -> list[np.ndarray]:
+        """Per-ZMW per-position QVs (parity: ConsensusQVs,
+        Consensus-inl.hpp:277-297), one batched sweep."""
+        muts_per_zmw = [mutlib.enumerate_unique(t) for t in self.tpls[: self.n_zmws]]
+        scores = self.score_mutations(muts_per_zmw)
+        out = []
+        for z in range(self.n_zmws):
+            ssum = np.zeros(len(self.tpls[z]))
+            for m, s in zip(muts_per_zmw[z], scores[z]):
+                if s < 0.0:
+                    ssum[m.start] += np.exp(s)
+            prob = 1.0 - 1.0 / (1.0 + ssum)
+            prob = np.maximum(prob, np.finfo(float).tiny)
+            out.append(np.round(-10.0 * np.log10(prob)).astype(np.int32))
+        return out
+
+    def global_zscores(self) -> np.ndarray:
+        """(Z,) z-score of the summed log-likelihood per ZMW."""
+        out = np.full(self.n_zmws, np.nan)
+        for z in range(self.n_zmws):
+            act = self.active[z]
+            if not act.any():
+                continue
+            var = self._ll_var[z][act].sum()
+            if var <= 0:
+                continue
+            ll = self.baselines[z][act].sum()
+            out[z] = (ll - self._ll_mu[z][act].sum()) / np.sqrt(var)
+        return out

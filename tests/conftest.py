@@ -1,9 +1,13 @@
 """Test configuration: force an 8-device virtual CPU mesh so sharding tests
-run anywhere; TPU-hardware runs use bench.py instead."""
+run anywhere and deterministically; TPU-hardware runs use bench.py instead.
+
+The override is unconditional: the ambient environment may set
+JAX_PLATFORMS to a single-chip TPU platform, which would break multi-device
+mesh tests."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
