@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""pbccs_tpu benchmark: batched Arrow polish throughput in ZMWs/sec.
+
+Workload: a bucket of simulated ZMWs (template length / passes from env or
+defaults), drafts corrupted so the refinement loop does real mutation work,
+run through the batched polisher (BatchPolisher.refine + consensus QVs) --
+the wall-clock-dominant stage of the CCS pipeline (SURVEY.md section 3.4).
+
+Prints ONE JSON line:
+  {"metric": "polish_zmws_per_sec", "value": N, "unit": "ZMW/s",
+   "vs_baseline": N}
+
+vs_baseline compares against the recorded single-socket CPU throughput of the
+same workload (BASELINE_LOCAL.json, written by `python bench.py
+--record-cpu-baseline`), per BASELINE.md: the reference publishes no numbers,
+so the baseline is measured on a faithful reimplementation.
+
+Usage:
+  python bench.py                      # bench on the default jax platform
+  python bench.py --record-cpu-baseline  # measure + store the CPU baseline
+Env knobs: BENCH_ZMWS (8), BENCH_TPL_LEN (300), BENCH_PASSES (8),
+BENCH_CORRUPTIONS (2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_LOCAL.json")
+
+
+def build_tasks(rng, n_zmws: int, tpl_len: int, n_passes: int,
+                n_corruptions: int):
+    from pbccs_tpu.parallel.batch import ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    tasks, truths = [], []
+    for z in range(n_zmws):
+        tpl, reads, strands, snr = simulate_zmw(rng, tpl_len, n_passes)
+        draft = tpl.copy()
+        for _ in range(n_corruptions):
+            pos = int(rng.integers(5, tpl_len - 5))
+            draft[pos] = (draft[pos] + 1 + int(rng.integers(0, 3))) % 4
+        tasks.append(ZmwTask(f"bench/{z}", draft, snr, reads, strands,
+                             [0] * n_passes, [len(draft)] * n_passes))
+        truths.append(tpl)
+    return tasks, truths
+
+
+def run_workload(tasks):
+    """One full polish: setup + lockstep refinement + QV sweep."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher
+
+    polisher = BatchPolisher(tasks)
+    results = polisher.refine(RefineOptions(max_iterations=10))
+    qvs = polisher.consensus_qvs()
+    return polisher, results, qvs
+
+
+def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int):
+    import numpy as np
+
+    rng = np.random.default_rng(20260729)
+    tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corruptions)
+
+    t0 = time.monotonic()
+    run_workload(tasks)  # warmup: compiles every program at bucket shapes
+    warm_s = time.monotonic() - t0
+
+    tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corruptions)
+    t0 = time.monotonic()
+    polisher, results, qvs = run_workload(tasks)
+    bench_s = time.monotonic() - t0
+
+    n_exact = sum(bool(np.array_equal(polisher.tpls[z], truths[z]))
+                  for z in range(n_zmws))
+    mean_qv = float(np.mean([q.mean() for q in qvs]))
+    return {
+        "zmws_per_sec": n_zmws / bench_s,
+        "bench_s": bench_s,
+        "warmup_s": warm_s,
+        "n_zmws": n_zmws,
+        "tpl_len": tpl_len,
+        "n_passes": n_passes,
+        "converged": sum(r.converged for r in results),
+        "exact_recoveries": n_exact,
+        "mean_qv": mean_qv,
+    }
+
+
+def main() -> None:
+    record_baseline = "--record-cpu-baseline" in sys.argv
+    if record_baseline:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # the axon plugin hooks interpreter startup; too late to strip here,
+        # but forcing the platform keeps compute on host CPU
+
+    n_zmws = int(os.environ.get("BENCH_ZMWS", 8))
+    tpl_len = int(os.environ.get("BENCH_TPL_LEN", 300))
+    n_passes = int(os.environ.get("BENCH_PASSES", 8))
+    n_corr = int(os.environ.get("BENCH_CORRUPTIONS", 2))
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"bench: platform={platform} Z={n_zmws} L={tpl_len} P={n_passes}",
+          file=sys.stderr)
+
+    stats = bench(n_zmws, tpl_len, n_passes, n_corr)
+    print(f"bench: {json.dumps(stats)}", file=sys.stderr)
+
+    if record_baseline:
+        with open(BASELINE_FILE, "w") as f:
+            json.dump({"cpu_zmws_per_sec": stats["zmws_per_sec"],
+                       "platform": platform,
+                       "config": {"n_zmws": n_zmws, "tpl_len": tpl_len,
+                                  "n_passes": n_passes,
+                                  "n_corruptions": n_corr}}, f, indent=2)
+        print(f"wrote {BASELINE_FILE}", file=sys.stderr)
+
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            baseline = json.load(f).get("cpu_zmws_per_sec")
+
+    vs_baseline = (stats["zmws_per_sec"] / baseline) if baseline else 1.0
+    print(json.dumps({
+        "metric": "polish_zmws_per_sec",
+        "value": round(stats["zmws_per_sec"], 4),
+        "unit": "ZMW/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
