@@ -1,0 +1,272 @@
+"""`ccs`-equivalent command line driver.
+
+    python -m pbccs_tpu.cli [OPTIONS] OUTPUT FILES...
+
+Reads subreads from BAM (PacBio conventions) or FASTA (records named
+movie/zmw[/s_e], grouped by ZMW), runs the consensus pipeline over a
+bounded ordered work pipeline, and writes a CCS BAM plus a CSV yield
+report.  Flags, defaults, CLI-level filters (whitelist, chemistry, SNR,
+read score, pass count) and output tags mirror the reference driver
+(reference src/main/ccs.cpp:284-519).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+import numpy as np
+
+from pbccs_tpu import __version__
+from pbccs_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    ReadGroupInfo,
+    make_read_group_id,
+)
+from pbccs_tpu.io.fasta import flatten_fofn, read_fasta
+from pbccs_tpu.io.report import write_results_report
+from pbccs_tpu.models.arrow.params import encode_bases
+from pbccs_tpu.pipeline import (
+    Chunk,
+    ConsensusSettings,
+    Failure,
+    ResultTally,
+    Subread,
+    process_chunks,
+)
+from pbccs_tpu.runtime.chemistry import verify_chemistry
+from pbccs_tpu.runtime.logging import Logger, LogLevel, install_signal_handlers
+from pbccs_tpu.runtime.whitelist import Whitelist
+from pbccs_tpu.runtime.workqueue import WorkQueue
+
+DESCRIPTION = ("Generate circular consensus sequences (ccs) from subreads "
+               "-- TPU-native implementation.")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ccs", description=DESCRIPTION)
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--zmws", default="all",
+                   help="ZMWs to process: all, or ranges like 1-3,5 or "
+                        "movie:1-3,5;movie2:*. Default = %(default)s")
+    p.add_argument("--minSnr", type=float, default=4.0,
+                   help="Minimum SNR of input subreads. Default = %(default)s")
+    p.add_argument("--minReadScore", type=float, default=0.75,
+                   help="Minimum read score of input subreads. Default = %(default)s")
+    p.add_argument("--minLength", type=int, default=10,
+                   help="Minimum length of subreads. Default = %(default)s")
+    p.add_argument("--minPasses", type=int, default=3,
+                   help="Minimum number of subreads required. Default = %(default)s")
+    p.add_argument("--minPredictedAccuracy", type=float, default=0.90,
+                   help="Minimum predicted accuracy. Default = %(default)s")
+    p.add_argument("--minZScore", type=float, default=-5.0,
+                   help="Minimum subread z-score; NaN disables. Default = %(default)s")
+    p.add_argument("--maxDropFraction", type=float, default=0.34,
+                   help="Maximum fraction of droppable subreads. Default = %(default)s")
+    p.add_argument("--numThreads", type=int, default=0,
+                   help="Number of host pipeline threads (0 = auto). "
+                        "Default = %(default)s")
+    p.add_argument("--chunkSize", type=int, default=4,
+                   help="ZMWs per work item. Default = %(default)s")
+    p.add_argument("--logFile", default=None, help="Log to a file vs stderr.")
+    p.add_argument("--logLevel", default="INFO",
+                   help="TRACE..FATAL. Default = %(default)s")
+    p.add_argument("--reportFile", default="ccs_report.csv",
+                   help="Where to write the yield report. Default = %(default)s")
+    p.add_argument("--skipChemistryCheck", action="store_true",
+                   help="Accept non-P6-C4 read groups (required for FASTA "
+                        "input, which carries no chemistry metadata).")
+    p.add_argument("output", help="Output BAM (or FASTA) path")
+    p.add_argument("files", nargs="+", help="Input subread BAM/FASTA/FOFN files")
+    return p
+
+
+def _iter_fasta_chunks(path: str, log: Logger):
+    """Group FASTA records named movie/zmw[/s_e] into per-ZMW chunks."""
+    current: Chunk | None = None
+    for name, seq in read_fasta(path):
+        parts = name.split("/")
+        if len(parts) < 2:
+            log.warn(f"skipping read {name}: name is not movie/zmw[/s_e]")
+            continue
+        movie, zmw = parts[0], parts[1]
+        zid = f"{movie}/{zmw}"
+        if current is None or current.id != zid:
+            if current is not None:
+                yield current, None
+            current = Chunk(zid, [], np.full(4, 8.0))
+        current.reads.append(Subread.from_str(name, seq))
+    if current is not None:
+        yield current, None
+
+
+def _iter_bam_chunks(path: str, log: Logger):
+    """Group BAM subread records into per-ZMW chunks.
+
+    Yields (chunk, read_group) so the caller can apply the chemistry gate."""
+    reader = BamReader(path)
+    rgs = {rg.id: rg for rg in reader.header.read_groups}
+    current: Chunk | None = None
+    current_rg: ReadGroupInfo | None = None
+    for rec in reader:
+        parts = rec.name.split("/")
+        if len(parts) < 2:
+            log.warn(f"skipping read {rec.name}: bad name")
+            continue
+        movie = parts[0]
+        hole = int(rec.tags.get("zm", parts[1]))
+        zid = f"{movie}/{hole}"
+        if current is None or current.id != zid:
+            if current is not None:
+                yield current, current_rg
+            snr = np.asarray(rec.tags.get("sn", [8.0] * 4), np.float64)
+            current = Chunk(zid, [], snr)
+            rg_id = rec.tags.get("RG", "")
+            current_rg = rgs.get(rg_id)
+        flags = int(rec.tags.get("cx", 3))
+        accuracy = float(rec.tags.get("rq", 0.8))
+        current.reads.append(Subread(rec.name, encode_bases(rec.seq),
+                                     flags=flags, read_accuracy=accuracy))
+    reader.close()
+    if current is not None:
+        yield current, current_rg
+
+
+def _chunks_from_files(files, whitelist: Whitelist, args, log,
+                       tally: ResultTally):
+    """Apply CLI-level gates and yield batches of chunks."""
+    batch: list[Chunk] = []
+    for path in files:
+        is_fasta = any(path.endswith(e)
+                       for e in (".fa", ".fasta", ".fa.gz", ".fsa"))
+        it = (_iter_fasta_chunks(path, log) if is_fasta
+              else _iter_bam_chunks(path, log))
+        for chunk, rg in it:
+            movie, hole_s = chunk.id.split("/")[:2]
+            hole = int(hole_s)
+            if not whitelist.contains(movie, hole):
+                continue
+            if not args.skipChemistryCheck:
+                if rg is None or not verify_chemistry(rg):
+                    log.notice(f"Skipping ZMW {chunk.id}, invalid chemistry "
+                               "(not P6/C4)")
+                    continue
+            if float(np.min(chunk.snr)) < args.minSnr:
+                log.debug(f"Skipping ZMW {chunk.id}, fails SNR threshold")
+                tally.tally(Failure.POOR_SNR)
+                continue
+            chunk.reads = [r for r in chunk.reads
+                           if r.read_accuracy >= args.minReadScore]
+            if len(chunk.reads) < args.minPasses:
+                log.debug(f"Skipping ZMW {chunk.id}, insufficient passes")
+                tally.tally(Failure.TOO_FEW_PASSES)
+                continue
+            batch.append(chunk)
+            if len(batch) >= args.chunkSize:
+                yield batch
+                batch = []
+    if batch:
+        yield batch
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    log = Logger.default(Logger(
+        stream=open(args.logFile, "w") if args.logFile else sys.stderr,
+        level=LogLevel.from_string(args.logLevel)))
+    install_signal_handlers(log)
+
+    try:
+        whitelist = Whitelist(args.zmws)
+    except ValueError as e:
+        print(f"option --zmws: invalid specification: {e}", file=sys.stderr)
+        return 2
+
+    settings = ConsensusSettings(
+        min_length=args.minLength,
+        min_passes=args.minPasses,
+        min_snr=args.minSnr,
+        min_predicted_accuracy=args.minPredictedAccuracy,
+        min_zscore=args.minZScore,
+        max_drop_fraction=args.maxDropFraction)
+
+    files = flatten_fofn(args.files)
+    for f in files:
+        if not os.path.exists(f):
+            print(f"input file does not exist: {f}", file=sys.stderr)
+            return 2
+
+    n_threads = args.numThreads or min(8, os.cpu_count() or 1)
+    tally = ResultTally()
+
+    # collect movie names for the output header
+    movies: dict[str, ReadGroupInfo] = {}
+
+    def writer_record(result) -> BamRecord:
+        movie = result.id.split("/")[0]
+        hole = int(result.id.split("/")[1])
+        return BamRecord(
+            name=f"{result.id}/ccs",
+            seq=result.sequence,
+            qual=result.qualities,
+            tags={
+                "RG": make_read_group_id(movie, "CCS"),
+                "zm": hole,
+                "np": int(result.num_passes),
+                "rq": int(1000 * result.predicted_accuracy),
+                "sn": [float(s) for s in result.snr],
+                "pq": float(result.predicted_accuracy),
+                "za": float(result.avg_zscore),
+                "zs": [float(z) if math.isfinite(z) else 0.0
+                       for z in result.zscores],
+                "rs": [int(c) for c in result.status_counts],
+            })
+
+    to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta"))
+    results_buffer = []
+
+    with WorkQueue(n_threads) as wq:
+        for batch in _chunks_from_files(files, whitelist, args, log, tally):
+            for chunk in batch:
+                movie = chunk.id.split("/")[0]
+                movies.setdefault(movie, ReadGroupInfo(movie, "CCS"))
+            wq.produce(process_chunks, batch, settings)
+        wq.finalize()
+        for sub_tally in wq.results():
+            tally.merge(sub_tally)
+
+    log.info(f"processed {tally.total} ZMWs: "
+             f"{tally.counts[Failure.SUCCESS]} successes")
+
+    if to_fasta:
+        from pbccs_tpu.io.fasta import write_fasta
+        write_fasta(args.output,
+                    ((f"{r.id}/ccs", r.sequence) for r in tally.results))
+    else:
+        header = BamHeader(read_groups=list(movies.values()),
+                           program_lines=[
+                               f"@PG\tID:ccs-{__version__}\tPN:ccs\t"
+                               f"VN:{__version__}"])
+        with BamWriter(args.output, header) as bw:
+            for result in tally.results:
+                bw.write(writer_record(result))
+
+    with open(args.reportFile, "w") as rf:
+        write_results_report(rf, tally)
+
+    log.flush()
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

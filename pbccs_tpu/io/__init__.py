@@ -1,0 +1,1 @@
+"""File IO: FASTA, BAM (BGZF), CSV yield reports, .fofn flattening."""

@@ -1,0 +1,345 @@
+"""BAM IO: BGZF (de)compression + unaligned PacBio BAM records, pure host.
+
+The reference delegates BAM IO to pbbam/htslib (CMakeLists.txt:54-66,
+src/main/ccs.cpp:52-54); this module provides the same capabilities
+natively: BGZF block framing over zlib raw-deflate, BAM record
+encode/decode, PacBio read-group conventions (movie//READTYPE derived
+read-group ids), and the CCS output tags (src/main/ccs.cpp:105-172).
+
+The writer/reader operate streamingly block-by-block so full SMRT cells
+never materialize in memory; a native C++ BGZF codec is the planned drop-in
+for the compression hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import struct
+import zlib
+from typing import BinaryIO, Iterator
+
+_BGZF_HEADER = (b"\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff\x06\x00\x42\x43\x02\x00")
+_BGZF_EOF = bytes.fromhex("1f8b08040000000000ff0600424302001b0003000000000000000000")
+_MAX_BLOCK = 64 * 1024 - 512  # uncompressed payload per BGZF block
+
+# 4-bit nucleotide encoding ("=ACMGRSVTWYHKDBN")
+_NIBBLE = {c: i for i, c in enumerate("=ACMGRSVTWYHKDBN")}
+_NIBBLE_INV = "=ACMGRSVTWYHKDBN"
+
+
+class BgzfWriter:
+    def __init__(self, fh: BinaryIO):
+        self._fh = fh
+        self._buf = bytearray()
+
+    def write(self, data: bytes) -> None:
+        self._buf += data
+        while len(self._buf) >= _MAX_BLOCK:
+            self._flush_block(self._buf[:_MAX_BLOCK])
+            del self._buf[:_MAX_BLOCK]
+
+    def _flush_block(self, chunk: bytes) -> None:
+        co = zlib.compressobj(6, zlib.DEFLATED, -15)
+        comp = co.compress(bytes(chunk)) + co.flush()
+        bsize = len(comp) + len(_BGZF_HEADER) + 2 + 8  # +BSIZE +CRC/ISIZE
+        self._fh.write(_BGZF_HEADER)
+        self._fh.write(struct.pack("<H", bsize - 1))
+        self._fh.write(comp)
+        self._fh.write(struct.pack("<I", zlib.crc32(bytes(chunk)) & 0xFFFFFFFF))
+        self._fh.write(struct.pack("<I", len(chunk) & 0xFFFFFFFF))
+
+    def close(self) -> None:
+        if self._buf:
+            self._flush_block(bytes(self._buf))
+            self._buf.clear()
+        self._fh.write(_BGZF_EOF)
+        self._fh.flush()
+
+
+class BgzfReader:
+    """Streaming BGZF reader: decodes one block at a time."""
+
+    def __init__(self, fh: BinaryIO):
+        self._fh = fh
+        self._buf = bytearray()
+        self._eof = False
+
+    def _fill(self) -> bool:
+        head = self._fh.read(12)
+        if len(head) < 12:
+            self._eof = True
+            return False
+        magic1, magic2, method, flags, _mtime, _xfl, _os, xlen = struct.unpack(
+            "<BBBBIBBH", head)
+        if (magic1, magic2) != (0x1F, 0x8B):
+            raise ValueError("not a BGZF/gzip stream")
+        extra = self._fh.read(xlen)
+        bsize = None
+        off = 0
+        while off + 4 <= len(extra):
+            si1, si2, slen = extra[off], extra[off + 1], struct.unpack(
+                "<H", extra[off + 2: off + 4])[0]
+            if (si1, si2) == (66, 67) and slen == 2:
+                bsize = struct.unpack("<H", extra[off + 4: off + 6])[0] + 1
+            off += 4 + slen
+        if bsize is None:
+            raise ValueError("missing BGZF BC subfield (plain gzip?)")
+        comp_len = bsize - 12 - xlen - 8
+        comp = self._fh.read(comp_len)
+        crc, isize = struct.unpack("<II", self._fh.read(8))
+        data = zlib.decompress(comp, -15)
+        if len(data) != isize or (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+            raise ValueError("corrupt BGZF block")
+        if not data:  # EOF marker block
+            return self._fill()
+        self._buf += data
+        return True
+
+    def read(self, n: int) -> bytes:
+        while len(self._buf) < n and not self._eof:
+            self._fill()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def make_read_group_id(movie_name: str, read_type: str) -> str:
+    """8-hex-digit read-group id from movie//READTYPE (PacBio convention
+    used by MakeReadGroupId, src/main/ccs.cpp:134)."""
+    return hashlib.md5(f"{movie_name}//{read_type}".encode()).hexdigest()[:8]
+
+
+@dataclasses.dataclass
+class ReadGroupInfo:
+    """One @RG header line (PacBio conventions: PU = movie name, DS holds
+    READTYPE/kits/basecaller-version key-values)."""
+
+    movie_name: str
+    read_type: str = "SUBREAD"
+    binding_kit: str = ""
+    sequencing_kit: str = ""
+    basecaller_version: str = ""
+    frame_rate_hz: str = ""
+
+    @property
+    def id(self) -> str:
+        return make_read_group_id(self.movie_name, self.read_type)
+
+    def to_sam(self) -> str:
+        ds = [f"READTYPE={self.read_type}"]
+        if self.binding_kit:
+            ds.append(f"BINDINGKIT={self.binding_kit}")
+        if self.sequencing_kit:
+            ds.append(f"SEQUENCINGKIT={self.sequencing_kit}")
+        if self.basecaller_version:
+            ds.append(f"BASECALLERVERSION={self.basecaller_version}")
+        if self.frame_rate_hz:
+            ds.append(f"FRAMERATEHZ={self.frame_rate_hz}")
+        return (f"@RG\tID:{self.id}\tPL:PACBIO\tDS:{';'.join(ds)}"
+                f"\tPU:{self.movie_name}")
+
+    @staticmethod
+    def from_sam(line: str) -> "ReadGroupInfo":
+        fields = dict(f.split(":", 1) for f in line.strip().split("\t")[1:]
+                      if ":" in f)
+        ds = dict(kv.split("=", 1) for kv in fields.get("DS", "").split(";")
+                  if "=" in kv)
+        return ReadGroupInfo(
+            movie_name=fields.get("PU", ""),
+            read_type=ds.get("READTYPE", ""),
+            binding_kit=ds.get("BINDINGKIT", ""),
+            sequencing_kit=ds.get("SEQUENCINGKIT", ""),
+            basecaller_version=ds.get("BASECALLERVERSION", ""),
+            frame_rate_hz=ds.get("FRAMERATEHZ", ""))
+
+
+@dataclasses.dataclass
+class BamHeader:
+    read_groups: list[ReadGroupInfo] = dataclasses.field(default_factory=list)
+    program_lines: list[str] = dataclasses.field(default_factory=list)
+    version: str = "1.5"
+    pacbio_version: str = "3.0b7"
+    sort_order: str = "unknown"
+
+    def to_text(self) -> str:
+        lines = [f"@HD\tVN:{self.version}\tSO:{self.sort_order}"
+                 f"\tpb:{self.pacbio_version}"]
+        lines += [rg.to_sam() for rg in self.read_groups]
+        lines += self.program_lines
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def from_text(text: str) -> "BamHeader":
+        header = BamHeader()
+        for line in text.splitlines():
+            if line.startswith("@RG"):
+                header.read_groups.append(ReadGroupInfo.from_sam(line))
+            elif line.startswith("@PG"):
+                header.program_lines.append(line)
+        return header
+
+
+@dataclasses.dataclass
+class BamRecord:
+    """An unaligned BAM record: name + seq + quals + tag dict.
+
+    Tag values: int, float, str, bytes (H), or list[int]/list[float]
+    (B arrays)."""
+
+    name: str
+    seq: str
+    qual: str = ""  # phred+33 ASCII, "" = absent (0xFF fill)
+    tags: dict = dataclasses.field(default_factory=dict)
+    flag: int = 4  # unmapped
+
+
+def _encode_tags(tags: dict) -> bytes:
+    out = bytearray()
+    for key, val in tags.items():
+        kb = key.encode()
+        if isinstance(val, bool):
+            raise TypeError("bool tag unsupported")
+        if isinstance(val, int):
+            out += kb + b"i" + struct.pack("<i", val)
+        elif isinstance(val, float):
+            out += kb + b"f" + struct.pack("<f", val)
+        elif isinstance(val, str):
+            out += kb + b"Z" + val.encode() + b"\x00"
+        elif isinstance(val, (list, tuple)):
+            if all(isinstance(v, int) for v in val):
+                out += kb + b"B" + b"i" + struct.pack("<I", len(val))
+                out += struct.pack(f"<{len(val)}i", *val)
+            else:
+                out += kb + b"B" + b"f" + struct.pack("<I", len(val))
+                out += struct.pack(f"<{len(val)}f", *[float(v) for v in val])
+        else:
+            raise TypeError(f"unsupported tag type for {key}: {type(val)}")
+    return bytes(out)
+
+
+_TAG_SCALARS = {"A": ("c", 1), "c": ("b", 1), "C": ("B", 1), "s": ("h", 2),
+                "S": ("H", 2), "i": ("i", 4), "I": ("I", 4), "f": ("f", 4)}
+
+
+def _decode_tags(data: bytes) -> dict:
+    tags = {}
+    off = 0
+    while off + 3 <= len(data):
+        key = data[off: off + 2].decode()
+        typ = chr(data[off + 2])
+        off += 3
+        if typ in _TAG_SCALARS:
+            fmt, size = _TAG_SCALARS[typ]
+            val = struct.unpack_from("<" + fmt, data, off)[0]
+            if typ == "A":
+                val = val.decode()
+            off += size
+        elif typ in ("Z", "H"):
+            end = data.index(b"\x00", off)
+            val = data[off:end].decode()
+            off = end + 1
+        elif typ == "B":
+            sub = chr(data[off])
+            n = struct.unpack_from("<I", data, off + 1)[0]
+            fmt, size = _TAG_SCALARS[sub]
+            val = list(struct.unpack_from(f"<{n}{fmt}", data, off + 5))
+            off += 5 + n * size
+        else:
+            raise ValueError(f"unknown tag type {typ!r}")
+        tags[key] = val
+    return tags
+
+
+class BamWriter:
+    """Unaligned BAM writer (no reference sequences)."""
+
+    def __init__(self, path: str, header: BamHeader):
+        self._fh = open(path, "wb")
+        self._bgzf = BgzfWriter(self._fh)
+        text = header.to_text().encode()
+        self._bgzf.write(b"BAM\x01" + struct.pack("<i", len(text)) + text
+                         + struct.pack("<i", 0))
+
+    def write(self, rec: BamRecord) -> None:
+        name = rec.name.encode() + b"\x00"
+        seq = rec.seq.upper()
+        l_seq = len(seq)
+        packed = bytearray()
+        for i in range(0, l_seq - 1, 2):
+            packed.append((_NIBBLE.get(seq[i], 15) << 4)
+                          | _NIBBLE.get(seq[i + 1], 15))
+        if l_seq % 2:
+            packed.append(_NIBBLE.get(seq[-1], 15) << 4)
+        if rec.qual:
+            qual = bytes(ord(c) - 33 for c in rec.qual)
+        else:
+            qual = b"\xff" * l_seq
+        tags = _encode_tags(rec.tags)
+        body = struct.pack("<iiBBHHHiiii", -1, -1, len(name), 255, 0, 0,
+                           rec.flag, l_seq, -1, -1, 0)
+        body += name + bytes(packed) + qual + tags
+        self._bgzf.write(struct.pack("<i", len(body)) + body)
+
+    def close(self) -> None:
+        self._bgzf.close()
+        self._fh.close()
+
+    def __enter__(self) -> "BamWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class BamReader:
+    """Iterate records of a BAM file (unaligned or aligned; alignments are
+    exposed as plain records, cigars ignored)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "rb")
+        self._bgzf = BgzfReader(self._fh)
+        magic = self._bgzf.read(4)
+        if magic != b"BAM\x01":
+            raise ValueError(f"{path}: not a BAM file")
+        l_text = struct.unpack("<i", self._bgzf.read(4))[0]
+        self.header = BamHeader.from_text(self._bgzf.read(l_text).decode())
+        n_ref = struct.unpack("<i", self._bgzf.read(4))[0]
+        for _ in range(n_ref):
+            l_name = struct.unpack("<i", self._bgzf.read(4))[0]
+            self._bgzf.read(l_name + 4)
+
+    def __iter__(self) -> Iterator[BamRecord]:
+        while True:
+            head = self._bgzf.read(4)
+            if len(head) < 4:
+                return
+            block_size = struct.unpack("<i", head)[0]
+            body = self._bgzf.read(block_size)
+            (_refid, _pos, l_name, _mapq, _bin, n_cigar, flag, l_seq,
+             _nref, _npos, _tlen) = struct.unpack_from("<iiBBHHHiiii", body)
+            off = 32
+            name = body[off: off + l_name - 1].decode()
+            off += l_name + 4 * n_cigar
+            nseq = (l_seq + 1) // 2
+            seq_bytes = body[off: off + nseq]
+            off += nseq
+            seq = "".join(
+                _NIBBLE_INV[(seq_bytes[i // 2] >> (4 if i % 2 == 0 else 0)) & 0xF]
+                for i in range(l_seq))
+            qual_raw = body[off: off + l_seq]
+            off += l_seq
+            qual = ("" if not qual_raw or qual_raw[0] == 0xFF
+                    else "".join(chr(q + 33) for q in qual_raw))
+            tags = _decode_tags(body[off:])
+            yield BamRecord(name=name, seq=seq, qual=qual, tags=tags,
+                            flag=flag)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self) -> "BamReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,32 @@
+"""End-of-run CSV yield report.
+
+Parity: reference WriteResultsReport (src/main/ccs.cpp:233-262): one line
+per yield category with count and percentage of total ZMWs.
+"""
+
+from __future__ import annotations
+
+from typing import TextIO
+
+from pbccs_tpu.pipeline import Failure, ResultTally
+
+_LABELS: list[tuple[Failure, str]] = [
+    (Failure.SUCCESS, "Success -- CCS generated"),
+    (Failure.POOR_SNR, "Failed -- Below SNR threshold"),
+    (Failure.NO_SUBREADS, "Failed -- No usable subreads"),
+    (Failure.TOO_SHORT, "Failed -- Insert size too small"),
+    (Failure.TOO_FEW_PASSES, "Failed -- Not enough full passes"),
+    (Failure.TOO_MANY_UNUSABLE, "Failed -- Too many unusable subreads"),
+    (Failure.NON_CONVERGENT, "Failed -- CCS did not converge"),
+    (Failure.POOR_QUALITY, "Failed -- CCS below minimum predicted accuracy"),
+    (Failure.OTHER, "Failed -- Exception thrown"),
+]
+
+
+def write_results_report(out: TextIO, tally: ResultTally) -> None:
+    total = max(tally.total, 1)
+    for failure, label in _LABELS:
+        if failure == Failure.OTHER and tally.counts[failure] == 0:
+            continue  # the reference has no Other line; only emit if nonzero
+        count = tally.counts[failure]
+        out.write(f"{label},{count},{100.0 * count / total:.2f}%\n")

@@ -1,0 +1,1 @@
+"""Host runtime: ZMW selection, ordered work pipeline, logging, chemistry."""

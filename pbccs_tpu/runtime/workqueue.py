@@ -1,0 +1,82 @@
+"""Ordered bounded work pipeline: produce tasks, consume results in order.
+
+The host-side scheduling spine of the CLI: a bounded pool runs consensus
+batches concurrently while a consumer drains results in submission order
+(so the output BAM preserves input order), with worker exceptions propagated
+to both producer and consumer.  Parity: reference include/pacbio/ccs/
+WorkQueue.h:53-217 (bounded head set, FIFO future queue, Finalize).
+
+On TPU the heavy lifting is batched device programs, so the pool's job is
+overlap of host stages (BAM decode, bucketing, writeback) with device
+compute -- threads, not processes, are the right tool (the GIL is released
+inside device calls and zlib).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+class WorkQueue:
+    """Bounded thread pool whose results are consumed in submission order."""
+
+    def __init__(self, n_workers: int, max_pending: int | None = None):
+        if n_workers < 1:
+            raise ValueError("need at least one worker")
+        self._pool = ThreadPoolExecutor(max_workers=n_workers,
+                                        thread_name_prefix="pbccs-worker")
+        self._sem = threading.BoundedSemaphore(max_pending or 3 * n_workers)
+        self._futures: queue.Queue[Future | None] = queue.Queue()
+        self._failed = threading.Event()
+
+    def produce(self, fn: Callable[..., T], *args, **kwargs) -> None:
+        """Submit a task; blocks when the pipeline is full (backpressure).
+
+        Raises immediately if a prior task already failed (reference
+        WorkQueue.h:108-111 exception propagation to the producer)."""
+        if self._failed.is_set():
+            raise RuntimeError("work queue failed; no new tasks accepted")
+        self._sem.acquire()
+
+        def run():
+            try:
+                return fn(*args, **kwargs)
+            except BaseException:
+                self._failed.set()
+                raise
+            finally:
+                self._sem.release()
+
+        self._futures.put(self._pool.submit(run))
+
+    def finalize(self) -> None:
+        """Signal that no more tasks will be produced."""
+        self._futures.put(None)
+
+    def results(self) -> Iterator:
+        """Yield task results in submission order; re-raises the first
+        worker exception (reference WorkQueue.h:129-166)."""
+        while True:
+            fut = self._futures.get()
+            if fut is None:
+                break
+            yield fut.result()
+
+    def consume_with(self, consumer: Callable[[T], None]) -> None:
+        for result in self.results():
+            consumer(result)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "WorkQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

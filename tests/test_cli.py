@@ -1,0 +1,119 @@
+"""CLI end-to-end: FASTA and BAM inputs -> CCS BAM + yield report.
+
+Pattern: the reference's integration test drives the ccs executable over a
+subread fixture (tests/python/test_tool_contract.py, TestData.h.in); here
+the CLI entry runs in-process over simulated subreads.
+"""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.cli import run
+from pbccs_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    ReadGroupInfo,
+    make_read_group_id,
+)
+from pbccs_tpu.io.fasta import write_fasta
+from pbccs_tpu.models.arrow.params import decode_bases
+from pbccs_tpu.simulate import simulate_zmw
+
+
+def make_zmw_records(rng, movie, hole, tpl_len=60, n_passes=4):
+    tpl, reads, strands, snr = simulate_zmw(rng, tpl_len, n_passes)
+    recs = []
+    for i, r in enumerate(reads):
+        recs.append((f"{movie}/{hole}/{i * 100}_{i * 100 + len(r)}",
+                     decode_bases(r)))
+    return tpl, recs, snr
+
+
+def test_cli_fasta_end_to_end(rng, tmp_path):
+    fasta = str(tmp_path / "subreads.fasta")
+    records = []
+    for hole in (1, 2):
+        _, recs, _ = make_zmw_records(rng, "movie1", hole)
+        records.extend(recs)
+    write_fasta(fasta, records)
+
+    out_bam = str(tmp_path / "out.bam")
+    report = str(tmp_path / "report.csv")
+    rc = run([out_bam, fasta, "--reportFile", report,
+              "--skipChemistryCheck", "--numThreads", "2",
+              "--logLevel", "WARN"])
+    assert rc == 0
+
+    with BamReader(out_bam) as br:
+        results = list(br)
+        assert {rg.read_type for rg in br.header.read_groups} == {"CCS"}
+    assert len(results) == 2
+    for rec in results:
+        assert rec.name.endswith("/ccs")
+        assert len(rec.seq) > 50
+        assert len(rec.qual) == len(rec.seq)
+        assert rec.tags["np"] >= 3
+        assert rec.tags["rq"] > 900
+
+    text = open(report).read()
+    assert "Success -- CCS generated,2," in text
+
+
+def test_cli_bam_input_with_chemistry(rng, tmp_path):
+    in_bam = str(tmp_path / "subreads.bam")
+    movie = "m140905_042212_sidney_c100564852550000001823085912221377_s1_X0"
+    header = BamHeader(read_groups=[
+        ReadGroupInfo(movie, "SUBREAD", binding_kit="100356300",
+                      sequencing_kit="100356200", basecaller_version="2.3.0")])
+    rg_id = make_read_group_id(movie, "SUBREAD")
+    _, recs, snr = make_zmw_records(rng, movie, 42, tpl_len=60, n_passes=4)
+    with BamWriter(in_bam, header) as bw:
+        for name, seq in recs:
+            bw.write(BamRecord(name=name, seq=seq, tags={
+                "RG": rg_id, "zm": 42, "cx": 3, "rq": 0.85,
+                "sn": [float(s) for s in snr]}))
+
+    out_bam = str(tmp_path / "out.bam")
+    report = str(tmp_path / "report.csv")
+    rc = run([out_bam, in_bam, "--reportFile", report,
+              "--numThreads", "1", "--logLevel", "WARN"])
+    assert rc == 0
+    with BamReader(out_bam) as br:
+        results = list(br)
+    assert len(results) == 1
+    assert results[0].name == f"{movie}/42/ccs"
+    assert results[0].tags["zm"] == 42
+
+
+def test_cli_whitelist_filters(rng, tmp_path):
+    fasta = str(tmp_path / "subreads.fasta")
+    records = []
+    for hole in (1, 2, 3):
+        _, recs, _ = make_zmw_records(rng, "movie1", hole)
+        records.extend(recs)
+    write_fasta(fasta, records)
+
+    out_bam = str(tmp_path / "out.bam")
+    rc = run([out_bam, fasta, "--zmws", "2",
+              "--reportFile", str(tmp_path / "r.csv"),
+              "--skipChemistryCheck", "--numThreads", "1",
+              "--logLevel", "WARN"])
+    assert rc == 0
+    with BamReader(out_bam) as br:
+        results = list(br)
+    assert [r.tags["zm"] for r in results] == [2]
+
+
+def test_cli_rejects_bad_whitelist(tmp_path):
+    fasta = str(tmp_path / "x.fasta")
+    write_fasta(fasta, [("m/1/0_4", "ACGT")])
+    rc = run([str(tmp_path / "o.bam"), fasta, "--zmws", "all;1-3"])
+    assert rc == 2
+
+
+def test_cli_missing_input(tmp_path):
+    rc = run([str(tmp_path / "o.bam"), str(tmp_path / "missing.bam"),
+              "--skipChemistryCheck"])
+    assert rc == 2

@@ -1,0 +1,104 @@
+"""IO round trips: FASTA, fofn, BGZF/BAM, CSV report."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    BgzfReader,
+    BgzfWriter,
+    ReadGroupInfo,
+    make_read_group_id,
+)
+from pbccs_tpu.io.fasta import flatten_fofn, read_fasta, write_fasta
+from pbccs_tpu.io.report import write_results_report
+from pbccs_tpu.pipeline import Failure, ResultTally
+
+
+def test_fasta_roundtrip(tmp_path):
+    path = tmp_path / "x.fasta"
+    records = [("m/1/0_5", "ACGTA"), ("m/2/0_7", "A" * 150)]
+    write_fasta(str(path), records, line_width=70)
+    assert list(read_fasta(str(path))) == records
+
+
+def test_flatten_fofn(tmp_path):
+    (tmp_path / "a.bam").write_bytes(b"")
+    (tmp_path / "b.bam").write_bytes(b"")
+    (tmp_path / "inner.fofn").write_text("a.bam\n")
+    (tmp_path / "outer.fofn").write_text(f"inner.fofn\n{tmp_path}/b.bam\n")
+    got = flatten_fofn([str(tmp_path / "outer.fofn")])
+    assert got == [str(tmp_path / "a.bam"), str(tmp_path / "b.bam")]
+
+
+def test_bgzf_roundtrip_large():
+    data = os.urandom(300_000)
+    buf = io.BytesIO()
+    w = BgzfWriter(buf)
+    w.write(data)
+    w.close()
+    buf.seek(0)
+    r = BgzfReader(buf)
+    assert r.read(len(data)) == data
+    assert r.read(10) == b""
+
+
+def test_bam_roundtrip(tmp_path):
+    path = str(tmp_path / "x.bam")
+    header = BamHeader(read_groups=[
+        ReadGroupInfo("movieA", "CCS", binding_kit="100356300",
+                      sequencing_kit="100356200", basecaller_version="2.3.0")])
+    rec = BamRecord(
+        name="movieA/7/ccs", seq="ACGTACGTTT", qual="IIIIIIIIII",
+        tags={"RG": make_read_group_id("movieA", "CCS"), "zm": 7, "np": 9,
+              "rq": 999, "sn": [7.5, 8.0, 9.25, 10.0], "pq": 0.999,
+              "za": -0.5, "zs": [0.1, -0.2], "rs": [5, 0, 0, 1, 0]})
+    with BamWriter(path, header) as bw:
+        bw.write(rec)
+
+    with BamReader(path) as br:
+        assert len(br.header.read_groups) == 1
+        rg = br.header.read_groups[0]
+        assert rg.movie_name == "movieA" and rg.read_type == "CCS"
+        assert rg.binding_kit == "100356300"
+        got = list(br)
+    assert len(got) == 1
+    g = got[0]
+    assert g.name == rec.name and g.seq == rec.seq and g.qual == rec.qual
+    assert g.tags["zm"] == 7 and g.tags["np"] == 9 and g.tags["rq"] == 999
+    np.testing.assert_allclose(g.tags["sn"], rec.tags["sn"])
+    assert g.tags["rs"] == rec.tags["rs"]
+    assert g.flag == 4  # unmapped
+
+
+def test_bam_odd_length_seq(tmp_path):
+    path = str(tmp_path / "odd.bam")
+    rec = BamRecord(name="m/1", seq="ACGTA", qual="", tags={})
+    with BamWriter(path, BamHeader()) as bw:
+        bw.write(rec)
+    with BamReader(path) as br:
+        got = list(br)[0]
+    assert got.seq == "ACGTA"
+    assert got.qual == ""  # 0xFF fill decodes to absent
+
+
+def test_results_report_format():
+    tally = ResultTally()
+    for _ in range(7):
+        tally.tally(Failure.SUCCESS)
+    tally.tally(Failure.POOR_SNR)
+    tally.tally(Failure.TOO_FEW_PASSES)
+    tally.tally(Failure.NON_CONVERGENT)
+    out = io.StringIO()
+    write_results_report(out, tally)
+    lines = out.getvalue().strip().split("\n")
+    assert lines[0] == "Success -- CCS generated,7,70.00%"
+    assert "Failed -- Below SNR threshold,1,10.00%" in lines
+    assert "Failed -- CCS did not converge,1,10.00%" in lines
+    assert len(lines) == 8  # Other suppressed when zero

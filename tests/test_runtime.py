@@ -1,0 +1,127 @@
+"""Intervals, whitelist, work queue, logging: host runtime components.
+
+Patterns: reference tests/TestInterval.cpp, TestWhitelist.cpp (incl.
+invalid-spec throws) and the WorkQueue ordering contract (WorkQueue.h).
+"""
+
+import io
+import time
+
+import pytest
+
+from pbccs_tpu.runtime.logging import Logger, LogLevel
+from pbccs_tpu.runtime.whitelist import Whitelist
+from pbccs_tpu.runtime.workqueue import WorkQueue
+from pbccs_tpu.utils.intervals import Interval, IntervalTree
+
+
+class TestInterval:
+    def test_from_string_single(self):
+        assert Interval.from_string("5") == Interval(5, 6)
+
+    def test_from_string_range(self):
+        assert Interval.from_string("3-7") == Interval(3, 8)
+
+    @pytest.mark.parametrize("bad", ["", "a", "7-3", "1-2-3", "-1"])
+    def test_from_string_invalid(self, bad):
+        with pytest.raises(ValueError):
+            Interval.from_string(bad)
+
+    def test_contains_overlaps(self):
+        i = Interval(2, 5)
+        assert i.contains(2) and i.contains(4) and not i.contains(5)
+        assert i.overlaps(Interval(4, 9))
+        assert not i.overlaps(Interval(5, 9))
+        assert i.touches(Interval(5, 9))
+
+
+class TestIntervalTree:
+    def test_merging(self):
+        t = IntervalTree()
+        t.insert(Interval(1, 3))
+        t.insert(Interval(5, 7))
+        assert len(t) == 2
+        t.insert(Interval(3, 5))  # bridges both
+        assert list(t) == [Interval(1, 7)]
+
+    def test_from_string_and_contains(self):
+        t = IntervalTree.from_string("1-3,5")
+        assert t.contains(1) and t.contains(3) and t.contains(5)
+        assert not t.contains(4) and not t.contains(0)
+
+    def test_gaps(self):
+        t = IntervalTree.from_string("1-3,7-9")
+        assert list(t.gaps()) == [Interval(4, 7)]
+
+
+class TestWhitelist:
+    def test_all(self):
+        for spec in ("all", "*:*"):
+            wl = Whitelist(spec)
+            assert wl.contains("anyMovie", 123)
+
+    def test_global_ranges(self):
+        for spec in ("1-3,5", "*:1-3,5"):
+            wl = Whitelist(spec)
+            assert wl.contains("m1", 2) and wl.contains("m2", 5)
+            assert not wl.contains("m1", 4)
+
+    def test_movie_scoped(self):
+        wl = Whitelist("movie1:1-3;movie2:*")
+        assert wl.contains("movie1", 2)
+        assert not wl.contains("movie1", 4)
+        assert wl.contains("movie2", 999)
+        assert not wl.contains("movie3", 1)
+
+    @pytest.mark.parametrize("bad", [
+        "all;1-3",            # all mixed with ranges
+        "1-3;movie:4",        # global then per-movie
+        "movie:1;movie:2",    # movie repeated
+        "a:b:c",              # too many parts
+    ])
+    def test_invalid_specs(self, bad):
+        with pytest.raises(ValueError):
+            Whitelist(bad)
+
+
+class TestWorkQueue:
+    def test_preserves_order(self):
+        def work(i):
+            time.sleep(0.01 * ((7 * i) % 5))  # jittered finish order
+            return i * i
+
+        with WorkQueue(4) as wq:
+            for i in range(20):
+                wq.produce(work, i)
+            wq.finalize()
+            assert list(wq.results()) == [i * i for i in range(20)]
+
+    def test_exception_propagates_to_consumer(self):
+        def work(i):
+            if i == 3:
+                raise RuntimeError("boom")
+            return i
+
+        with WorkQueue(2) as wq:
+            for i in range(6):
+                wq.produce(work, i)
+            wq.finalize()
+            with pytest.raises(RuntimeError, match="boom"):
+                list(wq.results())
+
+
+class TestLogger:
+    def test_levels_and_format(self):
+        buf = io.StringIO()
+        log = Logger(stream=buf, level=LogLevel.INFO)
+        log.debug("hidden")
+        log.info("shown")
+        log.flush()
+        out = buf.getvalue()
+        assert "hidden" not in out
+        assert "shown" in out and "INFO" in out
+
+    def test_from_string(self):
+        assert LogLevel.from_string("warn") == LogLevel.WARN
+        with pytest.raises(ValueError):
+            LogLevel.from_string("nope")
