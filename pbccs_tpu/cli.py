@@ -47,6 +47,8 @@ from pbccs_tpu.runtime.workqueue import WorkQueue
 DESCRIPTION = ("Generate circular consensus sequences (ccs) from subreads "
                "-- TPU-native implementation.")
 
+FASTA_EXTS = (".fa", ".fasta", ".fsa", ".fa.gz", ".fasta.gz", ".fsa.gz")
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ccs", description=DESCRIPTION)
@@ -91,10 +93,11 @@ def _iter_fasta_chunks(path: str, log: Logger):
     current: Chunk | None = None
     for name, seq in read_fasta(path):
         parts = name.split("/")
-        if len(parts) < 2:
+        try:
+            movie, zmw = parts[0], int(parts[1])
+        except (IndexError, ValueError):
             log.warn(f"skipping read {name}: name is not movie/zmw[/s_e]")
             continue
-        movie, zmw = parts[0], parts[1]
         zid = f"{movie}/{zmw}"
         if current is None or current.id != zid:
             if current is not None:
@@ -119,7 +122,11 @@ def _iter_bam_chunks(path: str, log: Logger):
             log.warn(f"skipping read {rec.name}: bad name")
             continue
         movie = parts[0]
-        hole = int(rec.tags.get("zm", parts[1]))
+        try:
+            hole = int(rec.tags.get("zm", parts[1]))
+        except (TypeError, ValueError):
+            log.warn(f"skipping read {rec.name}: no usable ZMW number")
+            continue
         zid = f"{movie}/{hole}"
         if current is None or current.id != zid:
             if current is not None:
@@ -142,8 +149,7 @@ def _chunks_from_files(files, whitelist: Whitelist, args, log,
     """Apply CLI-level gates and yield batches of chunks."""
     batch: list[Chunk] = []
     for path in files:
-        is_fasta = any(path.endswith(e)
-                       for e in (".fa", ".fasta", ".fa.gz", ".fsa"))
+        is_fasta = any(path.endswith(e) for e in FASTA_EXTS)
         it = (_iter_fasta_chunks(path, log) if is_fasta
               else _iter_bam_chunks(path, log))
         for chunk, rg in it:
@@ -228,8 +234,7 @@ def run(argv: list[str] | None = None) -> int:
                 "rs": [int(c) for c in result.status_counts],
             })
 
-    to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta"))
-    results_buffer = []
+    to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta", ".fsa"))
 
     with WorkQueue(n_threads) as wq:
         for batch in _chunks_from_files(files, whitelist, args, log, tally):

@@ -7,15 +7,17 @@ Parity: the reference loads subread fixtures via SeqAn FASTA
 
 from __future__ import annotations
 
+import gzip
 import os
 from typing import Iterator
 
 
 def read_fasta(path: str) -> Iterator[tuple[str, str]]:
-    """Yield (name, sequence) records."""
+    """Yield (name, sequence) records; .gz files are decompressed."""
     name: str | None = None
     parts: list[str] = []
-    with open(path) as f:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
         for line in f:
             line = line.strip()
             if not line:

@@ -193,6 +193,27 @@ def snr_to_transition_table(snr: jax.Array, dtype=jnp.float32) -> jax.Array:
     return jnp.stack([match, branch, stick, dark], axis=-1).astype(dtype)
 
 
+def snr_to_transition_table_host(snr: np.ndarray) -> np.ndarray:
+    """Float64 host evaluation of snr_to_transition_table.
+
+    The reference evaluates the SNR polynomial + softmax in double
+    (ContextParameterProvider.cpp:69-113); in float32 the exp(cubic) is
+    sensitive to op ordering, so eager vs jit/vmap evaluation of the jnp
+    version can disagree by ~0.4% per probability — enough to shift window
+    log-likelihoods by ~0.1 nat.  The table is tiny (8x4 per ZMW), so both
+    the per-ZMW and batched scorers compute it here, on host, in float64,
+    and feed the result into their jitted programs."""
+    snr = np.asarray(snr, np.float64)
+    chan_snr = np.tile(snr, 2)  # (8,)
+    powers = chan_snr[:, None] ** np.arange(4)  # (8, 4)
+    xb = np.exp(np.einsum("crp,cp->cr", CONTEXT_COEFF, powers))  # Dark,Match,Stick
+    denom = 1.0 + xb.sum(axis=-1)
+    return np.stack(
+        [xb[:, 1] / denom, 1.0 / denom, xb[:, 2] / denom, xb[:, 0] / denom],
+        axis=-1,
+    )
+
+
 def context_index(cur_base: jax.Array, next_base: jax.Array) -> jax.Array:
     """Dinucleotide context id: next_base + 4 * (cur != next).
 

@@ -26,7 +26,7 @@ from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
     revcomp,
-    snr_to_transition_table,
+    snr_to_transition_table_host,
     template_transition_params,
 )
 from pbccs_tpu.ops.fwdbwd import (
@@ -239,7 +239,8 @@ class ArrowMultiReadScorer:
             self._reads[i, :2] = [0, 0]
             self._tends[i] = min(2, len(tpl))
 
-        self.trans_table = snr_to_transition_table(jnp.asarray(self.snr))
+        self.trans_table = jnp.asarray(
+            snr_to_transition_table_host(self.snr), jnp.float32)
         self.active = np.zeros(R, bool)
         self.statuses = np.full(self.n_reads, ADD_OTHER, np.int32)
         self.zscores = np.full(self.n_reads, np.nan)

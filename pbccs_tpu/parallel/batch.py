@@ -31,7 +31,7 @@ from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
     revcomp_padded,
-    snr_to_transition_table,
+    snr_to_transition_table_host,
     template_transition_params,
 )
 from pbccs_tpu.models.arrow.refine import RefineOptions, RefineResult
@@ -73,14 +73,15 @@ class ZmwTask:
 
 
 @functools.partial(jax.jit, static_argnames=("width",))
-def _batch_setup(tpls, tlens, snrs, reads, rlens, strands, tstarts, tends,
+def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
                  width: int):
     """Per-ZMW template tracks + per-read window fills + moments.
 
-    All leading axes are (Z, ...) with reads (Z, R, Imax)."""
+    All leading axes are (Z, ...) with reads (Z, R, Imax).  `tables` are the
+    per-ZMW (8, 4) SNR transition tables, computed on host in float64
+    (snr_to_transition_table_host) so batched and per-ZMW scorers agree."""
 
-    def one_zmw(tpl, L, snr, reads1, rlens1, st1, ts1, te1):
-        table = snr_to_transition_table(snr)
+    def one_zmw(tpl, L, table, reads1, rlens1, st1, ts1, te1):
         trans_f = template_transition_params(tpl, table, L)
         tpl_r = revcomp_padded(tpl, L)
         trans_r = template_transition_params(tpl_r, table, L)
@@ -99,7 +100,7 @@ def _batch_setup(tpls, tlens, snrs, reads, rlens, strands, tstarts, tends,
 
         return fills + (trans_f, tpl_r, trans_r, table, mu, var)
 
-    return jax.vmap(one_zmw)(tpls, tlens, snrs, reads, rlens,
+    return jax.vmap(one_zmw)(tpls, tlens, tables, reads, rlens,
                              strands, tstarts, tends)
 
 
@@ -226,6 +227,9 @@ class BatchPolisher:
         self.active = np.zeros((Z, R), bool)
         self.statuses = np.full((Z, R), -1, np.int32)
         self.zscores = np.full((Z, R), np.nan)
+        self._host_tables = np.stack(
+            [snr_to_transition_table_host(self._snrs[z]) for z in range(Z)]
+        ).astype(np.float32)
         self._setup(first=True)
 
     # ------------------------------------------------------------------ setup
@@ -260,7 +264,8 @@ class BatchPolisher:
          ll_a, ll_b, self.a_prefix, self.b_suffix,
          self.trans_f, self.tpl_r, self.trans_r, self.table,
          mu, var) = _batch_setup(
-            self._shard(tl), self._shard(tlens), self._shard(self._snrs),
+            self._shard(tl), self._shard(tlens),
+            self._shard(self._host_tables),
             self._shard(self._reads, read_axis=1),
             self._shard(self._rlens, read_axis=1),
             self._shard(self._strands, read_axis=1),

@@ -60,7 +60,7 @@ class ChemistryMapping:
 
 def verify_chemistry(rg: ReadGroupInfo) -> bool:
     """The reference's hardcoded P6-C4-only gate (ccs.cpp:263-281)."""
-    bc_major = rg.basecaller_version[:3]
+    bc_major = ".".join(rg.basecaller_version.split(".")[:2])
     if bc_major not in ("2.1", "2.3"):
         return False
     if rg.sequencing_kit != "100356200":

@@ -34,20 +34,25 @@ class WorkQueue:
         self._sem = threading.BoundedSemaphore(max_pending or 3 * n_workers)
         self._futures: queue.Queue[Future | None] = queue.Queue()
         self._failed = threading.Event()
+        self._first_error: BaseException | None = None
 
     def produce(self, fn: Callable[..., T], *args, **kwargs) -> None:
         """Submit a task; blocks when the pipeline is full (backpressure).
 
-        Raises immediately if a prior task already failed (reference
-        WorkQueue.h:108-111 exception propagation to the producer)."""
+        Raises the original worker exception if a prior task already failed
+        (reference WorkQueue.h:108-111 exception propagation to the
+        producer)."""
         if self._failed.is_set():
-            raise RuntimeError("work queue failed; no new tasks accepted")
+            raise RuntimeError("work queue failed; no new tasks accepted"
+                               ) from self._first_error
         self._sem.acquire()
 
         def run():
             try:
                 return fn(*args, **kwargs)
-            except BaseException:
+            except BaseException as e:
+                if not self._failed.is_set():
+                    self._first_error = e
                 self._failed.set()
                 raise
             finally:

@@ -64,12 +64,12 @@ class IntervalTree:
 
     def __init__(self, intervals: Iterable[Interval] = ()):
         self._ivals: list[Interval] = []
+        self._lefts: list[int] = []
         for i in intervals:
             self.insert(i)
 
     def insert(self, interval: Interval) -> None:
-        lefts = [i.left for i in self._ivals]
-        lo = bisect.bisect_left(lefts, interval.left)
+        lo = bisect.bisect_left(self._lefts, interval.left)
         # absorb any neighbor that overlaps or touches
         start = lo
         while start > 0 and self._ivals[start - 1].touches(interval):
@@ -81,10 +81,10 @@ class IntervalTree:
         for i in self._ivals[start:end]:
             merged = Interval(min(merged.left, i.left), max(merged.right, i.right))
         self._ivals[start:end] = [merged]
+        self._lefts[start:end] = [merged.left]
 
     def contains(self, x: int) -> bool:
-        lefts = [i.left for i in self._ivals]
-        idx = bisect.bisect_right(lefts, x) - 1
+        idx = bisect.bisect_right(self._lefts, x) - 1
         return idx >= 0 and self._ivals[idx].contains(x)
 
     def gaps(self) -> "IntervalTree":

@@ -12,6 +12,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The ambient environment may import jax at interpreter startup (via a
+# sitecustomize that registers a TPU PJRT plugin and sets
+# JAX_PLATFORMS=<tpu-platform>); in that case the env override above is
+# captured too late, so force the config directly before any backend
+# initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
