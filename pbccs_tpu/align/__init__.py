@@ -1,0 +1,30 @@
+"""Exact pairwise aligners (host utilities).
+
+Parity targets: reference ConsensusCore/include/ConsensusCore/Align/
+{AlignConfig,PairwiseAlignment,AffineAlignment,LinearAlignment}.hpp.
+"""
+
+from pbccs_tpu.align.pairwise import (
+    GLOBAL,
+    LOCAL,
+    SEMIGLOBAL,
+    AlignConfig,
+    AlignParams,
+    PairwiseAlignment,
+    align,
+    target_to_query_positions,
+)
+from pbccs_tpu.align.affine import (
+    AffineAlignmentParams,
+    align_affine,
+    align_affine_iupac,
+)
+from pbccs_tpu.align.linear import align_linear
+
+__all__ = [
+    "GLOBAL", "SEMIGLOBAL", "LOCAL",
+    "AlignParams", "AlignConfig", "PairwiseAlignment",
+    "align", "target_to_query_positions",
+    "AffineAlignmentParams", "align_affine", "align_affine_iupac",
+    "align_linear",
+]

@@ -1,0 +1,193 @@
+"""Pairwise aligner tests.
+
+Golden expectations from reference
+ConsensusCore/src/Tests/TestPairwiseAlignment.cpp (representation, global
+alignment, TargetToQueryPositions, affine basics) plus property checks for
+the semiglobal/local extensions and the linear-memory aligner.
+"""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.align import (
+    GLOBAL,
+    LOCAL,
+    SEMIGLOBAL,
+    AlignConfig,
+    AlignParams,
+    PairwiseAlignment,
+    align,
+    align_affine,
+    align_affine_iupac,
+    align_linear,
+    target_to_query_positions,
+)
+from pbccs_tpu.align.linear import align_linear_score
+from pbccs_tpu.align.pairwise import align_score
+
+
+class TestRepresentation:
+    def test_basic(self):
+        a = PairwiseAlignment("GATC", "GA-C")
+        assert a.target == "GATC"
+        assert a.query == "GA-C"
+        assert a.length == 4
+        assert a.matches == 3
+        assert a.deletions == 1
+        assert a.mismatches == 0
+        assert a.insertions == 0
+        assert a.accuracy == pytest.approx(0.75)
+        assert a.transcript == "MMDM"
+
+    def test_mixed(self):
+        a = PairwiseAlignment("GATTA-CA", "CA-TAACA")
+        assert a.transcript == "RMDMMIMM"
+        assert a.accuracy == pytest.approx(5.0 / 8)
+        assert a.mismatches == 1
+        assert a.deletions == 1
+        assert a.insertions == 1
+        assert a.matches == 5
+
+    def test_double_gap_rejected(self):
+        with pytest.raises(ValueError):
+            PairwiseAlignment("A-C", "A-C")
+
+    def test_from_transcript_roundtrip(self):
+        a = PairwiseAlignment.from_transcript("MMDM", "GATC", "GAC")
+        assert a.target == "GATC"
+        assert a.query == "GA-C"
+
+
+class TestGlobal:
+    def test_exact(self):
+        a = align("GATT", "GATT")
+        assert a.accuracy == pytest.approx(1.0)
+        assert a.target == "GATT"
+        assert a.query == "GATT"
+        assert a.transcript == "MMMM"
+
+    def test_deletion(self):
+        a = align("GATT", "GAT")
+        assert a.accuracy == pytest.approx(0.75)
+        assert a.target == "GATT"
+        assert a.query == "GA-T"
+        assert a.transcript == "MMDM"
+
+    def test_big_gap(self):
+        a = align("GATTACA", "TT")
+        assert a.target == "GATTACA"
+        assert a.query == "--TT---"
+        assert a.accuracy == pytest.approx(2.0 / 7)
+
+    def test_score_is_edit_distance(self):
+        assert align_score("GATTACA", "GATTACA") == 0
+        assert align_score("GATTACA", "GATTCA") == -1
+        assert align_score("AAAA", "TTTT") == -4
+
+
+class TestTargetToQueryPositions:
+    def test_matches(self):
+        np.testing.assert_array_equal(
+            target_to_query_positions("MMM"), [0, 1, 2, 3])
+
+    def test_deletions(self):
+        np.testing.assert_array_equal(
+            target_to_query_positions("DMM"), [0, 0, 1, 2])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MDM"), [0, 1, 1, 2])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MMD"), [0, 1, 2, 2])
+
+    def test_insertions(self):
+        np.testing.assert_array_equal(
+            target_to_query_positions("IMM"), [1, 2, 3])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MIM"), [0, 2, 3])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MMI"), [0, 1, 3])
+
+    def test_mixed(self):
+        np.testing.assert_array_equal(
+            target_to_query_positions("MRM"), [0, 1, 2, 3])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MDIM"), [0, 1, 2, 3])
+        np.testing.assert_array_equal(
+            target_to_query_positions("MIDM"), [0, 2, 2, 3])
+
+
+class TestSemiglobalLocal:
+    def test_semiglobal_free_target_overhang(self):
+        a = align("AAAGATTACATTT", "GATTACA",
+                  AlignConfig(AlignParams(1, -2, -2, -2), SEMIGLOBAL))
+        assert a.query.strip("-") == "GATTACA"
+        assert a.target == "AAAGATTACATTT"
+        assert a.transcript == "DDDMMMMMMMDDD"
+
+    def test_local_returns_best_segment(self):
+        a = align("CCCCGATTACACCCC", "TTTGATTACATTT",
+                  AlignConfig(AlignParams(1, -2, -2, -2), LOCAL))
+        assert a.target == "GATTACA"
+        assert a.query == "GATTACA"
+        assert a.target_begin == 4
+        assert a.query_begin == 3
+
+
+class TestAffine:
+    def test_basics(self):
+        cases = [
+            ("ATT", "ATT", "ATT", "ATT"),
+            ("AT", "ATT", "A-T", "ATT"),
+            ("GA", "GAT", "GA-", "GAT"),
+            ("GAT", "GA", "GAT", "GA-"),
+            ("GA", "TGA", "-GA", "TGA"),
+            ("TGA", "GA", "TGA", "-GA"),
+            ("GATTACA", "GATTTACA", "GA-TTACA", "GATTTACA"),
+        ]
+        for target, query, want_t, want_q in cases:
+            a = align_affine(target, query)
+            assert a.target == want_t, (target, query)
+            assert a.query == want_q, (target, query)
+
+    def test_affine_prefers_contiguous_gap(self):
+        # two separate gaps cost 2 opens; one double gap costs open+extend
+        a = align_affine("AAAATTTTGGGG", "AAAAGGGG")
+        assert "TTTT" in a.target
+        gap_run = a.query.count("-")
+        assert gap_run == 4
+        i = a.query.index("-")
+        assert a.query[i : i + 4] == "----"
+
+    def test_iupac_partial_match(self):
+        # M = A/C: pairing M with A should beat pairing M with T
+        a = align_affine_iupac("ATM", "ATA")
+        assert a.transcript[-1] in "MR"
+        plain = align_affine_iupac("GGCT", "GGCT")
+        assert plain.transcript == "MMMM"
+
+
+class TestLinear:
+    def test_matches_quadratic(self, rng):
+        bases = np.array(list("ACGT"))
+        for trial in range(10):
+            n = int(rng.integers(1, 120))
+            m = int(rng.integers(1, 120))
+            t = "".join(rng.choice(bases, n))
+            q = "".join(rng.choice(bases, m))
+            assert align_linear_score(t, q) == align_score(t, q), (t, q)
+            a = align_linear(t, q)
+            # the gapped strings must reduce to the inputs
+            assert a.target.replace("-", "") == t
+            assert a.query.replace("-", "") == q
+
+    def test_long_alignment(self, rng):
+        bases = np.array(list("ACGT"))
+        t = "".join(rng.choice(bases, 2000))
+        # query = target with scattered edits
+        q = list(t)
+        for _ in range(40):
+            p = int(rng.integers(0, len(q)))
+            q[p] = str(rng.choice(bases))
+        q = "".join(q)
+        a = align_linear(t, q)
+        assert a.accuracy > 0.95
+        assert align_linear_score(t, q) == align_score(t, q)

@@ -1,0 +1,116 @@
+"""Seed finding + SDP chaining tests.
+
+Golden expectations from reference tests/TestSparseAlign.cpp (exact /
+partial / inserted / divergent pairs: chain length and endpoint checks)
+plus unit tests of the hash/mask layers and the band closure.
+"""
+
+import numpy as np
+
+from pbccs_tpu.align.seeds import (
+    anchor_bands,
+    chain_seeds,
+    find_seeds,
+    kmer_hashes,
+    sparse_align,
+)
+from pbccs_tpu.models.arrow.params import encode_bases
+
+S1 = "ACGTACACACAGTACAGTACAAGTTTCACGGACATTTGGTTCCCACTTGTACAGTGCACACGGGTTACACGT"
+
+
+class TestKmerHashes:
+    def test_distinct_and_positional(self):
+        h = kmer_hashes(encode_bases("ACGTACGT"), 4)
+        assert len(h) == 5
+        assert h[0] == h[4]  # ACGT == ACGT
+        assert len(set(h.tolist())) == 4
+
+    def test_pad_masks(self):
+        codes = encode_bases("ACGT")
+        codes = np.concatenate([codes, [4], codes])
+        h = kmer_hashes(codes, 4)
+        assert (h[1:4] == -1).all()
+        assert h[0] >= 0 and h[5] >= 0
+
+    def test_short_input(self):
+        assert len(kmer_hashes(encode_bases("AC"), 5)) == 0
+
+
+class TestFindSeeds:
+    def test_homopolymer_masked(self):
+        s = encode_bases("AAAAAAAA")
+        assert len(find_seeds(s, s, 5)) == 0
+
+    def test_self_match(self):
+        s = encode_bases(S1)
+        seeds = find_seeds(s, s, 5)
+        # every position matches itself (plus off-diagonal repeats)
+        diag = seeds[seeds[:, 0] == seeds[:, 1]]
+        assert len(diag) == len(S1) - 5 + 1
+
+
+class TestChain:
+    def test_exact_align(self):
+        s = encode_bases(S1)
+        chain = sparse_align(s, s, 5)
+        assert len(chain) == len(S1) - 5 + 1
+        assert tuple(chain[0]) == (0, 0)
+        assert tuple(chain[-1]) == (len(S1) - 5, len(S1) - 5)
+
+    def test_exact_partial(self):
+        s2 = "TTTGGTTCCCACTTGTACAGTGCACACGGGTTACACGT"
+        chain = sparse_align(encode_bases(S1), encode_bases(s2), 5)
+        assert len(chain) == len(s2) - 5 + 1
+        assert tuple(chain[0]) == (34, 0)
+        assert tuple(chain[-1]) == (len(S1) - 5, len(s2) - 5)
+
+    def test_insert_align(self):
+        s2 = ("ACGTACACACAGTACAGTACAAGTTTCACGGACAT" + "A" * 39 +
+              "TTGGTTCCCACTTGTACAGTGCACACGGGTTACACGT")
+        chain = sparse_align(encode_bases(S1), encode_bases(s2), 5)
+        assert tuple(chain[0]) == (0, 0)
+        assert tuple(chain[-1]) == (len(S1) - 5, len(s2) - 5)
+
+    def test_no_align(self):
+        s2 = "AAAATCCCCCCCCCCAGGGGG"
+        chain = sparse_align(encode_bases(S1), encode_bases(s2), 5)
+        assert len(chain) == 0
+
+    def test_divergent_align(self):
+        s2 = ("ACGTACACCAGTAAGTACAAGTTTCACGCGAATTTGGTTCCCACTTGTCAAGTGCACAC"
+              "GGGTTACACGT")
+        chain = sparse_align(encode_bases(S1), encode_bases(s2), 5)
+        assert tuple(chain[0]) == (0, 0)
+        assert tuple(chain[-1]) == (len(S1) - 5, len(s2) - 5)
+
+    def test_chain_monotone(self, rng):
+        bases = np.arange(4, dtype=np.int8)
+        s1 = rng.choice(bases, 400).astype(np.int8)
+        # derive s2 by point mutations
+        s2 = s1.copy()
+        for p in rng.integers(0, 400, 30):
+            s2[p] = (s2[p] + 1) % 4
+        chain = sparse_align(s1, s2, 6)
+        assert len(chain) > 10
+        assert (np.diff(chain[:, 0]) > 0).all()
+        assert (np.diff(chain[:, 1]) > 0).all()
+
+
+class TestAnchorBands:
+    def test_bands_cover_anchors(self):
+        chain = np.array([[10, 12], [50, 49], [90, 95]], np.int32)
+        bands = anchor_bands(chain, 100, 120, width=5)
+        assert bands.shape == (100, 2)
+        for i, j in chain:
+            assert bands[i, 0] <= max(j - 5, 0)
+            assert bands[i, 1] >= min(j + 5, 120)
+        # monotone, nonempty
+        assert (bands[:, 1] > bands[:, 0]).all()
+        assert (np.diff(bands[:, 0]) >= 0).all()
+        assert (np.diff(bands[:, 1]) >= 0).all()
+
+    def test_no_anchors_full_band(self):
+        bands = anchor_bands(np.zeros((0, 2), np.int32), 10, 20)
+        assert (bands[:, 0] == 0).all()
+        assert (bands[:, 1] == 20).all()
