@@ -35,6 +35,7 @@ from pbccs_tpu.ops.fwdbwd import (
     banded_forward,
     forward_loglik,
 )
+from pbccs_tpu.ops.fwdbwd_pallas import fills_use_pallas
 from pbccs_tpu.ops.mutation_score import (
     DEL,
     INS,
@@ -60,13 +61,8 @@ def _next_pow2(n: int, lo: int = 8) -> int:
     return v
 
 
-def oriented_window_fill(read, rlen, strand, ts, te,
-                         tpl_f, trans_f, tpl_r, trans_r, L, width: int):
-    """Build one read's oriented template window and fill its alpha/beta.
-
-    Returns (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
-    alpha_scale_prefix, beta_scale_suffix).  Shared by the per-ZMW scorer and
-    the batched ZMW driver (pbccs_tpu.parallel.batch)."""
+def oriented_window(strand, ts, te, tpl_f, trans_f, tpl_r, trans_r, L):
+    """Build one read's oriented template window (bases, transitions, len)."""
     Jmax = tpl_f.shape[0]
     ws = jnp.where(strand == 0, ts, L - te)
     wlen = te - ts
@@ -76,24 +72,56 @@ def oriented_window_fill(read, rlen, strand, ts, te,
     trans = jnp.where(strand == 0, trans_f[src], trans_r[src])
     win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
     win_trans = jnp.where((idx < wlen - 1)[:, None], trans, 0.0)
-    alpha = banded_forward(read, rlen, win_tpl, win_trans, wlen, width)
-    beta = banded_backward(read, rlen, win_tpl, win_trans, wlen, width)
-    ll_a = forward_loglik(alpha, rlen, wlen)
-    ll_b = backward_loglik(beta, wlen)
-    return (win_tpl, win_trans, wlen, alpha, beta, ll_a, ll_b,
-            scale_prefix(alpha.log_scales), scale_suffix(beta.log_scales))
+    return win_tpl, win_trans, wlen
 
 
-@functools.partial(jax.jit, static_argnames=("width",))
+def fill_alpha_beta_batch(reads, rlens, win_tpl, win_trans, wlens, width: int,
+                          use_pallas: bool | None = None):
+    """Batched alpha/beta fills + log-likelihoods + scale prefixes.
+
+    Dispatches to the Pallas TPU kernel (ops.fwdbwd_pallas) when available,
+    else the pure-JAX banded path.  All args carry a leading read-batch axis.
+    Returns (alpha, beta, ll_a, ll_b, alpha_prefix, beta_suffix).
+
+    `use_pallas` must be resolved by the caller when this runs under jit --
+    the dispatch is a trace-time decision, so jitted callers thread it
+    through as a static argument (else a stale executable would silently
+    ignore a changed PBCCS_PALLAS)."""
+    from pbccs_tpu.ops import fwdbwd_pallas as fpal
+
+    if use_pallas is None:
+        use_pallas = fpal.fills_use_pallas()
+    if use_pallas:
+        alpha = fpal.pallas_forward_batch(reads, rlens, win_tpl, win_trans,
+                                          wlens, width)
+        beta = fpal.pallas_backward_batch(reads, rlens, win_tpl, win_trans,
+                                          wlens, width)
+        ll_a = fpal.forward_loglik_batch(alpha, rlens, wlens)
+        ll_b = fpal.backward_loglik_batch(beta, wlens)
+    else:
+        alpha = jax.vmap(lambda r, i, t, tr, j: banded_forward(r, i, t, tr, j, width))(
+            reads, rlens, win_tpl, win_trans, wlens)
+        beta = jax.vmap(lambda r, i, t, tr, j: banded_backward(r, i, t, tr, j, width))(
+            reads, rlens, win_tpl, win_trans, wlens)
+        ll_a = jax.vmap(forward_loglik)(alpha, rlens, wlens)
+        ll_b = jax.vmap(backward_loglik)(beta, wlens)
+    apre = jax.vmap(scale_prefix)(alpha.log_scales)
+    bsuf = jax.vmap(scale_suffix)(beta.log_scales)
+    return alpha, beta, ll_a, ll_b, apre, bsuf
+
+
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
 def _setup_reads(reads, rlens, strands, tstarts, tends,
-                 tpl_f, trans_f, tpl_r, trans_r, L, width: int):
+                 tpl_f, trans_f, tpl_r, trans_r, L, width: int,
+                 use_pallas: bool):
     """Build per-read oriented windows and fill alpha/beta for each read."""
-
-    def one(read, rlen, strand, ts, te):
-        return oriented_window_fill(read, rlen, strand, ts, te,
-                                    tpl_f, trans_f, tpl_r, trans_r, L, width)
-
-    return jax.vmap(one)(reads, rlens, strands, tstarts, tends)
+    win_tpl, win_trans, wlens = jax.vmap(
+        lambda s, a, b: oriented_window(s, a, b, tpl_f, trans_f,
+                                        tpl_r, trans_r, L)
+    )(strands, tstarts, tends)
+    alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
+        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas)
+    return (win_tpl, win_trans, wlens, alpha, beta, ll_a, ll_b, apre, bsuf)
 
 
 def window_moments(strand, ts, te, mean_f, var_f, mean_r, var_r, L):
@@ -275,7 +303,7 @@ class ArrowMultiReadScorer:
             jnp.asarray(self._strands), jnp.asarray(self._tstarts),
             jnp.asarray(self._tends),
             self.tpl_f, self.trans_f, self.tpl_r, self.trans_r,
-            jnp.int32(L), self._W)
+            jnp.int32(L), self._W, fills_use_pallas())
 
         ll_a = np.asarray(ll_a, np.float64)
         ll_b = np.asarray(ll_b, np.float64)

@@ -265,16 +265,16 @@ def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
     cols = cols_rev[::-1]            # columns 1..Jmax-1
     log_scales_mid = ls_rev[::-1]
 
-    # Column J seed and column 0 terminal.
+    # Column J seed, then column 0 terminal from the *assembled* column 1
+    # (for J == 1 column 1 is the seed itself).
     seedJ = jnp.zeros(W, jnp.float32).at[jnp.clip(I - offsets[J], 0, W - 1)].set(1.0)
-    b11 = _gather_band(cols[0], offsets[1], jnp.asarray([1], jnp.int32))[0]
-    em0 = jnp.where(read_i32[0] == tpl_i32[0], em_hit, em_miss)
-    beta00 = b11 * em0
-    col0 = jnp.zeros(W, jnp.float32).at[0].set(beta00)
-
-    vals = jnp.concatenate([col0[None], cols], axis=0)       # cols 0..Jmax-1
+    vals = jnp.concatenate([jnp.zeros((1, W)), cols], axis=0)  # cols 0..Jmax-1
     vals = jnp.concatenate([vals, jnp.zeros((1, W))], axis=0)
     vals = vals.at[J].set(seedJ)
+    b11 = _gather_band(vals[1], offsets[1], jnp.asarray([1], jnp.int32))[0]
+    em0 = jnp.where(read_i32[0] == tpl_i32[0], em_hit, em_miss)
+    beta00 = b11 * em0
+    vals = vals.at[0].set(jnp.zeros(W, jnp.float32).at[0].set(beta00))
     log_scales = jnp.concatenate([jnp.zeros(1), log_scales_mid, jnp.zeros(1)])
     return BandedMatrix(vals, offsets, log_scales)
 

@@ -40,8 +40,10 @@ from pbccs_tpu.models.arrow.scorer import (
     ADD_POOR_ZSCORE,
     ADD_SUCCESS,
     _AB_MISMATCH_TOL,
+    fill_alpha_beta_batch,
+    fills_use_pallas,
     interior_read_scores,
-    oriented_window_fill,
+    oriented_window,
     window_moments,
 )
 from pbccs_tpu.ops.fwdbwd import BandedMatrix
@@ -72,25 +74,27 @@ class ZmwTask:
     tends: Sequence[int]
 
 
-@functools.partial(jax.jit, static_argnames=("width",))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
 def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
-                 width: int):
+                 width: int, use_pallas: bool):
     """Per-ZMW template tracks + per-read window fills + moments.
 
     All leading axes are (Z, ...) with reads (Z, R, Imax).  `tables` are the
     per-ZMW (8, 4) SNR transition tables, computed on host in float64
-    (snr_to_transition_table_host) so batched and per-ZMW scorers agree."""
+    (snr_to_transition_table_host) so batched and per-ZMW scorers agree.
+    Window building vmaps over (ZMW, read); the alpha/beta fills run on the
+    flattened (Z*R) read batch so the Pallas kernel path serves every read
+    in one launch."""
 
-    def one_zmw(tpl, L, table, reads1, rlens1, st1, ts1, te1):
+    def one_zmw(tpl, L, table, st1, ts1, te1):
         trans_f = template_transition_params(tpl, table, L)
         tpl_r = revcomp_padded(tpl, L)
         trans_r = template_transition_params(tpl_r, table, L)
 
-        def one_read(read, rlen, strand, ts, te):
-            return oriented_window_fill(read, rlen, strand, ts, te,
-                                        tpl, trans_f, tpl_r, trans_r, L, width)
-
-        fills = jax.vmap(one_read)(reads1, rlens1, st1, ts1, te1)
+        win = jax.vmap(
+            lambda s, a, b: oriented_window(s, a, b, tpl, trans_f,
+                                            tpl_r, trans_r, L)
+        )(st1, ts1, te1)
 
         mean_f, var_f = per_base_mean_and_variance(trans_f)
         mean_r, var_r = per_base_mean_and_variance(trans_r)
@@ -98,10 +102,22 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
             lambda s, a, b: window_moments(s, a, b, mean_f, var_f, mean_r, var_r, L)
         )(st1, ts1, te1)
 
-        return fills + (trans_f, tpl_r, trans_r, table, mu, var)
+        return win + (trans_f, tpl_r, trans_r, table, mu, var)
 
-    return jax.vmap(one_zmw)(tpls, tlens, tables, reads, rlens,
-                             strands, tstarts, tends)
+    (win_tpl, win_trans, wlens, trans_f, tpl_r, trans_r, table, mu, var) = \
+        jax.vmap(one_zmw)(tpls, tlens, tables, strands, tstarts, tends)
+
+    Z, R = reads.shape[:2]
+    flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
+    alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
+        flat(reads), flat(rlens), flat(win_tpl), flat(win_trans),
+        flat(wlens), width, use_pallas)
+    unflat = lambda a: a.reshape((Z, R) + a.shape[1:])
+    alpha = jax.tree.map(unflat, alpha)
+    beta = jax.tree.map(unflat, beta)
+    return (win_tpl, win_trans, wlens, alpha, beta,
+            unflat(ll_a), unflat(ll_b), unflat(apre), unflat(bsuf),
+            trans_f, tpl_r, trans_r, table, mu, var)
 
 
 @jax.jit
@@ -271,7 +287,12 @@ class BatchPolisher:
             self._shard(self._strands, read_axis=1),
             self._shard(self._tstarts, read_axis=1),
             self._shard(self._tends, read_axis=1),
-            self._W)
+            self._W,
+            # pallas_call has no SPMD partitioning rule: under a mesh GSPMD
+            # would all-gather the flattened coefficient tensors and run the
+            # kernel replicated on every device, so mesh runs stay on the
+            # shardable JAX fill path.
+            use_pallas=fills_use_pallas() and self.mesh is None)
         self.alpha, self.beta = alpha, beta
         self._tpl_dev = self._shard(tl)
 

@@ -1,0 +1,150 @@
+"""Pallas banded-fill kernel vs the pure-JAX reference path.
+
+Pattern from the reference suite: the same scores must come out of every
+kernel implementation (reference ConsensusCore TestRecursors.cpp:63-69 runs
+one test body over scalar/SSE and dense/sparse recursors; here the pair is
+JAX lax.scan vs the Pallas column-scan kernel, run in interpret mode on
+CPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pbccs_tpu.models.arrow.params import (
+    snr_to_transition_table_host,
+    template_transition_params,
+)
+from pbccs_tpu.ops import fwdbwd as fb
+from pbccs_tpu.ops import fwdbwd_pallas as fp
+
+
+def noisy_read(rng, tpl, sub=0.08, dele=0.06, ins=0.07):
+    out = []
+    for b in tpl:
+        u = rng.random()
+        if u < sub:
+            out.append(int(rng.integers(0, 4)))
+        elif u < sub + dele:
+            continue
+        else:
+            out.append(int(b))
+            if rng.random() < ins:
+                out.append(int(rng.integers(0, 4)))
+    return np.array(out, np.int8)
+
+
+def _batch(rng, specs, Imax, Jmax, snr=8.0):
+    """Build a padded read/template batch from (read_len_hint, tpl_len)."""
+    R = len(specs)
+    reads = np.full((R, Imax), 4, np.int8)
+    rlens = np.zeros(R, np.int32)
+    tpls = np.full((R, Jmax), 4, np.int8)
+    tlens = np.zeros(R, np.int32)
+    trans = np.zeros((R, Jmax, 4), np.float32)
+    table = snr_to_transition_table_host(np.full(4, snr))
+    for r, (_, J) in enumerate(specs):
+        tpl = rng.integers(0, 4, J).astype(np.int8)
+        read = noisy_read(rng, tpl)
+        if len(read) == 0:
+            read = np.array([0], np.int8)
+        I = min(len(read), Imax)
+        reads[r, :I] = read[:I]
+        rlens[r] = I
+        tpls[r, :J] = tpl
+        tlens[r] = J
+        padded = np.pad(tpl, (0, Jmax - J), constant_values=4)
+        trans[r] = np.asarray(template_transition_params(
+            jnp.asarray(padded), jnp.asarray(table, jnp.float32), jnp.int32(J)))
+    return tuple(jnp.asarray(x) for x in (reads, rlens, tpls, trans, tlens))
+
+
+WIDTH = 48
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(20260730)
+    specs = [(0, 2), (0, 1), (0, 5), (0, 90), (0, 64), (0, 80), (0, 33)]
+    return _batch(rng, specs, Imax=160, Jmax=96)
+
+
+def test_forward_matches_jax_path(batch):
+    reads, rlens, tpls, trans, tlens = batch
+    pa = fp.pallas_forward_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    for r in range(reads.shape[0]):
+        a = fb.banded_forward(reads[r], rlens[r], tpls[r], trans[r], tlens[r], WIDTH)
+        np.testing.assert_allclose(np.asarray(pa.vals[r]), np.asarray(a.vals),
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(pa.offsets[r]),
+                                      np.asarray(a.offsets))
+        np.testing.assert_allclose(np.asarray(pa.log_scales[r]),
+                                   np.asarray(a.log_scales), atol=1e-5)
+
+
+def test_backward_matches_jax_path(batch):
+    reads, rlens, tpls, trans, tlens = batch
+    pb = fp.pallas_backward_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    for r in range(reads.shape[0]):
+        b = fb.banded_backward(reads[r], rlens[r], tpls[r], trans[r], tlens[r], WIDTH)
+        np.testing.assert_allclose(np.asarray(pb.vals[r]), np.asarray(b.vals),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pb.log_scales[r]),
+                                   np.asarray(b.log_scales), atol=1e-5)
+
+
+def test_logliks_match_and_mate(batch):
+    """alpha/beta LLs agree with the JAX path and with each other (the
+    reference's AlphaBetaMismatch mating check, SimpleRecursor.cpp:667-691)."""
+    reads, rlens, tpls, trans, tlens = batch
+    pa = fp.pallas_forward_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    pb = fp.pallas_backward_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    lla = np.asarray(fp.forward_loglik_batch(pa, rlens, tlens))
+    llb = np.asarray(fp.backward_loglik_batch(pb, tlens))
+    for r in range(reads.shape[0]):
+        a = fb.banded_forward(reads[r], rlens[r], tpls[r], trans[r], tlens[r], WIDTH)
+        ref = float(fb.forward_loglik(a, rlens[r], tlens[r]))
+        assert abs(lla[r] - ref) < 2e-3, (r, lla[r], ref)
+        assert abs(1.0 - lla[r] / llb[r]) < 1e-3, (r, lla[r], llb[r])
+
+
+def test_fill_dispatch_forced_pallas(monkeypatch, batch):
+    """fill_alpha_beta_batch with PBCCS_PALLAS=1 (interpret mode on CPU)
+    agrees with the default JAX dispatch."""
+    from pbccs_tpu.models.arrow.scorer import fill_alpha_beta_batch
+
+    reads, rlens, tpls, trans, tlens = batch
+    monkeypatch.delenv("PBCCS_PALLAS", raising=False)
+    ref = fill_alpha_beta_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    monkeypatch.setenv("PBCCS_PALLAS", "1")
+    got = fill_alpha_beta_batch(reads, rlens, tpls, trans, tlens, WIDTH)
+    for g, r in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-3)
+
+
+def test_band_shift_clamp_drops_read_not_crashes():
+    """A read/template length ratio beyond the kernel's max band shift must
+    produce a (finite or -inf) score, never garbage; the scorer drops such
+    reads via the mating gate."""
+    rng = np.random.default_rng(7)
+    tpl = rng.integers(0, 4, 16).astype(np.int8)
+    read = np.concatenate([np.repeat(tpl, 12)])[:180].astype(np.int8)  # ~11x
+    Imax, Jmax = 192, 96
+    reads = np.full((1, Imax), 4, np.int8)
+    reads[0, :len(read)] = read
+    table = snr_to_transition_table_host(np.full(4, 8.0))
+    padded = np.pad(tpl, (0, Jmax - len(tpl)), constant_values=4)
+    trans = np.asarray(template_transition_params(
+        jnp.asarray(padded), jnp.asarray(table, jnp.float32),
+        jnp.int32(len(tpl))))[None]
+    pa = fp.pallas_forward_batch(
+        jnp.asarray(reads), jnp.asarray([len(read)], jnp.int32),
+        jnp.asarray(padded[None]), jnp.asarray(trans),
+        jnp.asarray([len(tpl)], jnp.int32), WIDTH)
+    ll = np.asarray(fp.forward_loglik_batch(
+        pa, jnp.asarray([len(read)], jnp.int32),
+        jnp.asarray([len(tpl)], jnp.int32)))
+    assert not np.isnan(ll).any()
+    # the clamped band cannot represent this read: it must be deterministically
+    # droppable (LL at the log-tiny floor), not silently mis-scored
+    assert ll[0] < -60.0
