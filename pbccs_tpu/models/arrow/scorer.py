@@ -35,15 +35,14 @@ from pbccs_tpu.ops.fwdbwd import (
     banded_forward,
     forward_loglik,
 )
-from pbccs_tpu.ops.fwdbwd_pallas import fills_use_pallas
+from pbccs_tpu.ops.fwdbwd_pallas import _MAX_SHIFT as _MAX_BAND_SHIFT, fills_use_pallas
 from pbccs_tpu.ops.mutation_score import (
     DEL,
     INS,
     SUB,
     MutationPatch,
-    extend_link_score,
-    full_refill_score,
-    make_patch,
+    interior_read_scores_fast,
+    make_patches_fast,
     scale_prefix,
     scale_suffix,
 )
@@ -155,8 +154,7 @@ def _read_moments(strands, tstarts, tends, trans_f, trans_r, L):
 
 @jax.jit
 def _make_patches(tpl, trans, trans_table, L, pos, mtype, new_base):
-    return jax.vmap(lambda p, t, b: make_patch(tpl, trans, trans_table, L, p, t, b))(
-        pos, mtype, new_base)
+    return make_patches_fast(tpl, trans, trans_table, L, pos, mtype, new_base)
 
 
 def interior_read_scores(read, rlen, strand, ts, te, wt, wtr, wl,
@@ -164,18 +162,17 @@ def interior_read_scores(read, rlen, strand, ts, te, wt, wtr, wl,
                          mpos_f, mend_f, mtype,
                          patches_f: MutationPatch, patches_r: MutationPatch):
     """(M,) absolute mutated-template log-likelihoods of one read via
-    extend+link, given forward-frame mutation arrays + fwd/rev patches."""
-    read32 = read.astype(jnp.int32)
-    wt32 = wt.astype(jnp.int32)
+    extend+link, given forward-frame mutation arrays + fwd/rev patches.
 
-    def per_mut(pf, ef, mt, patf, patr):
-        p = jnp.where(strand == 0, pf - ts, te - ef)
-        patch = jax.tree.map(lambda a, b: jnp.where(strand == 0, a, b), patf, patr)
-        return extend_link_score(read32, rlen, wt32, wtr, wl,
-                                 alpha, beta, apre, bsuf,
-                                 p, mt, patch)
-
-    return jax.vmap(per_mut)(mpos_f, mend_f, mtype, patches_f, patches_r)
+    Routed through the gather-free batched scorer
+    (ops.mutation_score.interior_read_scores_fast); the per-mutation
+    extend_link_score path it replaced is kept in ops.mutation_score as the
+    reference implementation, with parity enforced by
+    tests/test_mutation_fast.py."""
+    return interior_read_scores_fast(read, rlen, strand, ts, te, wt, wtr, wl,
+                                     alpha, beta, apre, bsuf,
+                                     mpos_f, mend_f, mtype,
+                                     patches_f, patches_r)
 
 
 @jax.jit
@@ -203,23 +200,44 @@ def _score_interior(reads, rlens, strands, tstarts, tends,
                               a_prefix, b_suffix)
 
 
-@functools.partial(jax.jit, static_argnames=("width",))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
 def _score_edge(reads, rlens, win_tpl, win_trans, wlens,
                 pair_read, pair_p, pair_type,
-                patch_bases, patch_trans, patch_shift, width: int):
-    """(E,) absolute LLs via full banded refill of the mutated window."""
+                patch_bases, patch_trans, patch_shift, width: int,
+                use_pallas: bool):
+    """(E,) absolute LLs via full banded refill of the mutated window.
 
-    def one(ridx, p, mt, pb, pt, ps):
-        read = reads[ridx].astype(jnp.int32)
-        rlen = rlens[ridx]
-        wt = win_tpl[ridx].astype(jnp.int32)
-        wtr = win_trans[ridx]
-        wl = wlens[ridx]
-        patch = MutationPatch(pb, pt, ps)
-        return full_refill_score(read, rlen, wt, wtr, wl, p, mt, patch, width)
+    Per-pair read/window rows are picked with one-hot matmuls (runtime-index
+    row gathers lower to the TPU scalar core) and the mutated windows are
+    built densely with static shifts; the (E,) fills then run through the
+    batched fill dispatch (Pallas kernel on TPU)."""
+    from pbccs_tpu.ops.fwdbwd_pallas import (
+        forward_loglik_batch, pallas_forward_batch)
+    from pbccs_tpu.ops.mutation_score import _row_select, mutated_windows_per_pair
 
-    return jax.vmap(one)(pair_read, pair_p, pair_type,
-                         patch_bases, patch_trans, patch_shift)
+    R, Imax = reads.shape
+    Jm = win_tpl.shape[1]
+    reads_e = _row_select(pair_read, reads.astype(jnp.float32)).astype(jnp.int8)
+    sel = _row_select(pair_read, jnp.concatenate(
+        [rlens[:, None].astype(jnp.float32),
+         wlens[:, None].astype(jnp.float32),
+         win_tpl.astype(jnp.float32)], axis=1))
+    rlens_e = sel[:, 0].astype(jnp.int32)
+    wlens_e = sel[:, 1].astype(jnp.int32)
+    wt_e = sel[:, 2:].astype(jnp.int32)
+    wtr_e = _row_select(pair_read, win_trans.reshape(R, Jm * 4)).reshape(-1, Jm, 4)
+
+    patch = MutationPatch(patch_bases, patch_trans, patch_shift)
+    bases, trans, new_lens = mutated_windows_per_pair(
+        wt_e, wtr_e, wlens_e, pair_p, pair_type, patch)
+
+    if use_pallas:
+        alpha = pallas_forward_batch(reads_e, rlens_e, bases, trans,
+                                     new_lens, width)
+        return forward_loglik_batch(alpha, rlens_e, new_lens)
+    alpha = jax.vmap(lambda r, i, t, tr, j: banded_forward(r, i, t, tr, j, width))(
+        reads_e, rlens_e, bases, trans, new_lens)
+    return jax.vmap(forward_loglik)(alpha, rlens_e, new_lens)
 
 
 class ArrowMultiReadScorer:
@@ -310,6 +328,12 @@ class ArrowMultiReadScorer:
         self.baselines = ll_b
         mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
         mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
+        # reads whose band advances >MAX_SHIFT rows/column are outside the
+        # shift-select range of both the Pallas fill kernel and the
+        # gather-free interior scorer; drop them deterministically on every
+        # path (the reference drops such reads via AlphaBetaMismatch too)
+        mated &= self._rlens <= _MAX_BAND_SHIFT * np.maximum(
+            self._tends - self._tstarts, 1)
 
         mu, var = _read_moments(
             jnp.asarray(self._strands), jnp.asarray(self._tstarts),
@@ -452,7 +476,7 @@ class ArrowMultiReadScorer:
                 self.win_tpl, self.win_trans, self.wlens,
                 jnp.asarray(pr), jnp.asarray(pp), jnp.asarray(pt),
                 jnp.asarray(pb), jnp.asarray(ptr), jnp.asarray(psh),
-                self._W), np.float64)[:E]
+                self._W, fills_use_pallas()), np.float64)[:E]
             np.add.at(totals, em_, edge_ll - self.baselines[er])
 
         return totals

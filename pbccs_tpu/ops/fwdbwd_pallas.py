@@ -77,7 +77,7 @@ def fills_use_pallas() -> bool:
     elsewhere (the pure-JAX path is the CPU reference)."""
     env = os.environ.get("PBCCS_PALLAS")
     if env is not None:
-        return env not in ("0", "false", "")
+        return env.strip().lower() not in ("0", "false", "off", "no", "")
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:
@@ -113,21 +113,32 @@ def _rev_clip_rows(x, top: int, nc: int):
     return jnp.concatenate([lead, body, tail], axis=0)[:nc]
 
 
-def _window_rows(x, starts, W: int):
-    """y[j] = x[starts[j] : starts[j] + W] for small-integer x.
+def window_rows(x, starts, W: int, exact: bool = False):
+    """y[j] = x[starts[j] : starts[j] + W] as a one-hot matmul on the MXU.
 
-    Implemented as a one-hot matmul on the MXU: gathers with runtime start
-    indices lower to the TPU scalar core (~50x slower than this whole fill);
-    a (nc, N) one-hot times the (N, W) im2col of x is exact for the 0..4
-    base codes (both operands exactly representable in bf16) and rides the
-    systolic array instead."""
+    Gathers with runtime start indices lower to the TPU scalar core (~50x
+    slower than the fill they feed), so the windows are picked by a (nc, N)
+    one-hot times the (N, W) im2col of x on the systolic array instead.
+    With exact=False both operands ride bf16 -- exact for the 0..4 base
+    codes; exact=True keeps f32 at HIGHEST precision for general values
+    (the default TPU f32 dot truncates operands to bf16)."""
     N = x.shape[0]
-    xp = jnp.concatenate([x, jnp.zeros(W, x.dtype)])
+    xf = x.astype(jnp.float32)
+    xp = jnp.concatenate([xf, jnp.zeros(W, jnp.float32)])
     im2col = jnp.stack([xp[k: k + N] for k in range(W)], axis=1)   # (N, W)
     onehot = starts[:, None] == jnp.arange(N, dtype=starts.dtype)[None, :]
-    res = jax.lax.dot(onehot.astype(jnp.bfloat16), im2col.astype(jnp.bfloat16),
-                      preferred_element_type=jnp.float32)
+    if exact:
+        res = jax.lax.dot(onehot.astype(jnp.float32), im2col,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+    else:
+        res = jax.lax.dot(onehot.astype(jnp.bfloat16),
+                          im2col.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
     return res.astype(x.dtype)
+
+
+_window_rows = window_rows  # internal alias used by the coefficient builders
 
 
 def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):

@@ -37,6 +37,7 @@ from pbccs_tpu.models.arrow.params import (
     context_index,
 )
 from pbccs_tpu.ops.fwdbwd import BandedMatrix, _affine_scan, _gather_band, banded_forward, forward_loglik
+from pbccs_tpu.ops.fwdbwd_pallas import window_rows
 
 SUB, INS, DEL = 0, 1, 2
 _TINY = 1e-30
@@ -249,3 +250,342 @@ def scale_prefix(log_scales):
 def scale_suffix(log_scales):
     """beta_suffix[k] = sum(log_scales[k:]); shape (n+1,)."""
     return jnp.concatenate([jnp.cumsum(log_scales[::-1])[::-1], jnp.zeros(1)])
+
+
+# --------------------------------------------------------------------------
+# TPU-fast batched interior scoring (gather-free)
+#
+# jnp.take / vmapped dynamic_slice with runtime indices lower to the TPU
+# scalar core (measured ~50x slower than the arithmetic they feed).  The
+# batched path below reformulates every lookup in extend_link_score as
+# either a one-hot matmul row-select (MXU; exact, since one-hot rows pick a
+# single f32 value) or a bounded-range shift-variant select on the band
+# axis (VPU).
+# --------------------------------------------------------------------------
+
+
+def _shift_last(x, t: int):
+    """y[..., k] = x[..., k+t], zeros shifted in; static t."""
+    if t == 0:
+        return x
+    W = x.shape[-1]
+    if abs(t) >= W:
+        return jnp.zeros_like(x)
+    pad = [(0, 0)] * (x.ndim - 1)
+    if t > 0:
+        return jnp.pad(x[..., t:], pad + [(0, t)])
+    return jnp.pad(x[..., :t], pad + [(-t, 0)])
+
+
+def _select_shift(x, d, dmin: int, dmax: int):
+    """y[m, k] = x[m, k + d[m]] for per-row dynamic d in [dmin, dmax]
+    (zeros outside the band); single-level static-shift select.
+
+    NOTE composing two zero-fill shifts is NOT a zero-fill shift of the sum
+    (intermediate shifts clip edge lanes), so each variant must be one
+    direct static shift."""
+    r = jnp.clip(d, dmin, dmax)
+    out = jnp.zeros_like(x)
+    for t in range(dmin, dmax + 1):
+        out = jnp.where(r[..., None] == t, _shift_last(x, t), out)
+    return out
+
+
+def _row_select(idx, src):
+    """sel[m] = src[clip(idx[m], 0, n-1)] as a one-hot matmul.
+
+    idx: (M,) int; src: (n, K) -> (M, K) f32 (exact: one-hot rows pick a
+    single element, f32 * 1.0 sums of one term)."""
+    n = src.shape[0]
+    oh = (jnp.clip(idx, 0, n - 1)[:, None] ==
+          jnp.arange(n, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+    # HIGHEST precision is load-bearing: the default TPU f32 dot truncates
+    # operands to bf16, which corrupts selected values (e.g. a -38.09 scale
+    # prefix picks up ~0.1 of error -- enough to flip mutation decisions)
+    return jax.lax.dot(oh, src.astype(jnp.float32),
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+
+_NB = 7      # virtual-template neighborhood: positions p-3 .. p+3
+
+
+def _neighborhoods(win_tpl_f32, win_trans):
+    """Per-column neighborhood matrices: nb_tpl[j, c] = win_tpl[clip(j+c-3)],
+    nb_trans[j, c, :] = win_trans[clip(j+c-3)]; static shifts only."""
+    Jm = win_tpl_f32.shape[0]
+    cols_t, cols_r = [], []
+    for c in range(_NB):
+        t = c - 3
+        idx_lo, idx_hi = max(0, -t), Jm - max(0, t)
+        head = max(0, -t)
+        tail = max(0, t)
+        tpl_sh = jnp.concatenate([
+            jnp.broadcast_to(win_tpl_f32[0:1], (head,)),
+            win_tpl_f32[max(0, t): Jm + min(0, t)],
+            jnp.broadcast_to(win_tpl_f32[Jm - 1:], (tail,)),
+        ])
+        tr_sh = jnp.concatenate([
+            jnp.broadcast_to(win_trans[0:1], (head, 4)),
+            win_trans[max(0, t): Jm + min(0, t)],
+            jnp.broadcast_to(win_trans[Jm - 1:], (tail, 4)),
+        ], axis=0)
+        cols_t.append(tpl_sh)
+        cols_r.append(tr_sh)
+    return jnp.stack(cols_t, axis=1), jnp.stack(cols_r, axis=1)
+
+
+def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
+                         alpha: BandedMatrix, beta: BandedMatrix,
+                         alpha_prefix, beta_suffix,
+                         p, mtype, patch_bases, patch_trans, patch_shift,
+                         pr_miscall: float = MISMATCH_PROBABILITY):
+    """(M,) absolute mutated-template log-likelihoods of one read for
+    *interior* mutations; gather-free equivalent of
+    vmap(extend_link_score) over the mutation axis.
+
+    read: (Imax,) int32; p/mtype: (M,) oriented window-frame mutations;
+    patch_*: (M, 2), (M, 2, 4), (M,) oriented virtual-mutation patches.
+    """
+    W = alpha.width
+    nc = alpha.vals.shape[0]
+    eps = pr_miscall
+    hit, em_miss = 1.0 - eps, eps / 3.0
+
+    I = jnp.asarray(read_len, jnp.int32)
+    J = jnp.asarray(win_len, jnp.int32)
+    ld = jnp.where(mtype == INS, 1, jnp.where(mtype == DEL, -1, 0))
+    mend = p + jnp.where(mtype == INS, 0, 1)
+    s = jnp.where(mtype == DEL, p - 1, p)
+    max_left = J + ld
+    blc = 1 + mend                       # beta link column
+    abs_col = blc + ld
+
+    # ---- read windows per column (MXU im2col) --------------------------
+    read_f = read.astype(jnp.float32)
+    offs = alpha.offsets
+    rnext_win = window_rows(read_f, offs, W, exact=True)     # read[o_j + k]
+    rbase_win = window_rows(
+        jnp.concatenate([read_f[0:1], read_f]), offs, W,
+        exact=True)                                          # read[o_j + k - 1]
+
+    # ---- per-mutation row-selects (one matmul per index array) ---------
+    offs_f = offs.astype(jnp.float32)[:, None]
+    sel_sm1 = _row_select(s - 1, jnp.concatenate([alpha.vals, offs_f], axis=1))
+    A_prev, o_sm1 = sel_sm1[:, :W], sel_sm1[:, W].astype(jnp.int32)
+
+    apre_col = alpha_prefix[:nc][:, None]
+    sel_s = _row_select(s, jnp.concatenate([rbase_win, offs_f, apre_col], axis=1))
+    rb_s, o_s, apre_s = sel_s[:, :W], sel_s[:, W].astype(jnp.int32), sel_s[:, W + 1]
+
+    sel_s1 = _row_select(s + 1, jnp.concatenate(
+        [rbase_win, rnext_win, offs_f], axis=1))
+    rb_s1 = sel_s1[:, :W]
+    rn_s1 = sel_s1[:, W: 2 * W]
+    o_s1 = sel_s1[:, 2 * W].astype(jnp.int32)
+
+    boffs_f = beta.offsets.astype(jnp.float32)[:, None]
+    bsuf_col = beta_suffix[:nc][:, None]
+    sel_b = _row_select(blc, jnp.concatenate([beta.vals, boffs_f, bsuf_col], axis=1))
+    B_col, o_b, bsuf_b = sel_b[:, :W], sel_b[:, W].astype(jnp.int32), sel_b[:, W + 1]
+
+    nb_tpl, nb_trans = _neighborhoods(win_tpl.astype(jnp.float32), win_trans)
+    sel_p = _row_select(p, jnp.concatenate(
+        [nb_tpl, nb_trans.reshape(nb_tpl.shape[0], _NB * 4)], axis=1))
+    nbt = sel_p[:, :_NB]                                      # (M, 7)
+    nbr = sel_p[:, _NB:].reshape(-1, _NB, 4)                  # (M, 7, 4)
+
+    # ---- virtual base / transition lookups around p --------------------
+    pb0, pb1 = patch_bases[:, 0].astype(jnp.float32), patch_bases[:, 1].astype(jnp.float32)
+
+    def vb(c):
+        """virtual base at window index p + c; c: (M,) in [-3, 2]."""
+        col = jnp.clip(c + 3 + jnp.where(c > 0, patch_shift, 0), 0, _NB - 1)
+        raw = jnp.sum(jnp.where(col[:, None] == jnp.arange(_NB), nbt, 0.0), axis=1)
+        return jnp.where(c == -1, pb0, jnp.where(c == 0, pb1, raw))
+
+    def vt(c):
+        """virtual transition row at window index p + c -> (M, 4)."""
+        col = jnp.clip(c + 3 + jnp.where(c > 0, patch_shift, 0), 0, _NB - 1)
+        raw = jnp.sum(jnp.where((col[:, None] == jnp.arange(_NB))[:, :, None],
+                                nbr, 0.0), axis=1)
+        raw = jnp.where((c == -1)[:, None], patch_trans[:, 0], raw)
+        return jnp.where((c == 0)[:, None], patch_trans[:, 1], raw)
+
+    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
+
+    # explicit two-column extension (j = s, then j = s + 1)
+    def one_col(prev_vals, d, o_col, rbase_row, jcol, cur_b, next_b,
+                prev_tr, cur_tr):
+        rows = o_col[:, None] + karange
+        in_read = (rows >= 1) & (rows <= I)
+        em = jnp.where(rbase_row == cur_b[:, None], hit, em_miss)
+        pm1 = _select_shift(prev_vals, d - 1, -1, 7)
+        p0 = _select_shift(prev_vals, d, 0, 7)
+
+        generic = (rows < I) & (jcol < max_left)[:, None]
+        pinned = (rows == I) & (jcol == max_left)[:, None]
+        mfac = jnp.where(generic, prev_tr[:, TRANS_MATCH][:, None],
+                         jnp.where(pinned, 1.0, 0.0))
+        b = pm1 * em * mfac
+        b = b + jnp.where(((jcol > 1) & (jcol < max_left))[:, None]
+                          & (rows != I),
+                          p0 * prev_tr[:, TRANS_DARK][:, None], 0.0)
+        b = jnp.where(in_read, b, 0.0)
+
+        ins_em = jnp.where(rbase_row == next_b[:, None],
+                           cur_tr[:, TRANS_BRANCH][:, None],
+                           cur_tr[:, TRANS_STICK][:, None] / 3.0)
+        c = jnp.where(in_read & (rows > 1) & (rows < I)
+                      & (jcol != max_left)[:, None], ins_em, 0.0)
+        return _affine_scan(b, c)
+
+    c_sm1 = s - 1 - p
+    c_s = s - p
+    c_s1 = s + 1 - p
+    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s,
+                   vb(c_sm1), vb(c_s), vt(c_sm1 - 1), vt(c_sm1))
+    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s + 1,
+                   vb(c_s), vb(c_s1), vt(c_s - 1), vt(c_s))
+
+    # LinkAlphaBeta
+    rows = o_s1[:, None] + karange
+    link_tr = vt(abs_col - 2 - p)
+    link_b = vb(abs_col - 1 - p)
+    em_link = jnp.where(rn_s1 == link_b[:, None], hit, em_miss)
+    d_b = o_s1 - o_b
+    beta_ip1 = _select_shift(B_col, d_b + 1, -20, 1)
+    beta_i = _select_shift(B_col, d_b, -21, 0)
+    match_term = jnp.where(rows < I, ext1 * link_tr[:, TRANS_MATCH][:, None]
+                           * em_link * beta_ip1, 0.0)
+    del_term = ext1 * link_tr[:, TRANS_DARK][:, None] * beta_i
+    v = jnp.sum(match_term + del_term, axis=1)
+    return jnp.log(jnp.maximum(v, _TINY)) + apre_s + bsuf_b
+
+
+def interior_read_scores_fast(read, rlen, strand, ts, te, win_tpl, win_trans,
+                              wl, alpha: BandedMatrix, beta: BandedMatrix,
+                              apre, bsuf, mpos_f, mend_f, mtype,
+                              patches_f: MutationPatch, patches_r: MutationPatch):
+    """(M,) absolute mutated-template LLs of one read: orients the
+    forward-frame mutations into the read's window frame, then runs the
+    gather-free batched interior scorer.  Drop-in for
+    vmap(extend_link_score)-based interior_read_scores."""
+    p = jnp.where(strand == 0, mpos_f - ts, te - mend_f)
+    fwd = strand == 0
+    pb = jnp.where(fwd, patches_f.bases, patches_r.bases)
+    pt = jnp.where(fwd, patches_f.trans, patches_r.trans)
+    ps = jnp.where(fwd, patches_f.shift, patches_r.shift)
+    return interior_scores_fast(read.astype(jnp.int32), rlen,
+                                win_tpl.astype(jnp.int32), win_trans, wl,
+                                alpha, beta, apre, bsuf,
+                                p, mtype, pb, pt, ps)
+
+
+def _shift_rows(x, t: int):
+    """y[i] = x[clip(i + t, 0, n-1)] along axis 0 (static t, edge-replicated)."""
+    if t == 0:
+        return x
+    n = x.shape[0]
+    if t > 0:
+        tail = jnp.broadcast_to(x[n - 1:], (t,) + x.shape[1:])
+        return jnp.concatenate([x[t:], tail], axis=0)
+    head = jnp.broadcast_to(x[0:1], (-t,) + x.shape[1:])
+    return jnp.concatenate([head, x[:t]], axis=0)
+
+
+def make_patches_fast(tpl, trans, trans_table, tpl_len, pos, mtype, new_base) -> MutationPatch:
+    """Batched virtual-mutation patches, gather-free.
+
+    tpl: (Lm,) int32; trans: (Lm, 4); trans_table: (8, 4); pos/mtype/
+    new_base: (M,).  Returns MutationPatch with leaves (M, 2), (M, 2, 4),
+    (M,).  Same values as vmap(make_patch) but every template lookup is a
+    one-hot matmul row-select and every SNR-table lookup a (M, 8) one-hot
+    matmul, so nothing lowers to the TPU scalar core."""
+    L = jnp.asarray(tpl_len, jnp.int32)
+    tpl_f = tpl.astype(jnp.float32)[:, None]
+    # stacked per-position source: [tpl[i-1], tpl[i], tpl[i+1], trans[i+1]]
+    src = jnp.concatenate(
+        [_shift_rows(tpl_f, -1), tpl_f, _shift_rows(tpl_f, 1),
+         _shift_rows(trans, 1)], axis=1)                      # (Lm, 7)
+    sel = _row_select(pos, src)
+    prev_b = sel[:, 0].astype(jnp.int32)
+    cur_b = sel[:, 1].astype(jnp.int32)
+    next_b = sel[:, 2].astype(jnp.int32)
+    trans_p1 = sel[:, 3:7]
+    nb = jnp.asarray(new_base, jnp.int32)
+
+    def ctx_of(a, b):
+        idx = jnp.clip(context_index(a, b), 0, 7)
+        oh = (idx[:, None] == jnp.arange(8)).astype(jnp.float32)
+        return jax.lax.dot(oh, trans_table.astype(jnp.float32),
+                           preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST)
+
+    zeros4 = jnp.zeros_like(trans_p1)
+    ctx_prev_nb = ctx_of(prev_b, nb)
+    sub_b = jnp.stack([prev_b, nb], axis=1)
+    sub_t = jnp.stack([
+        jnp.where((pos > 0)[:, None], ctx_prev_nb, zeros4),
+        jnp.where((pos + 1 < L)[:, None], ctx_of(nb, next_b), zeros4),
+    ], axis=1)
+    org_last = L - 1
+    mid = (pos > 0) & (pos < org_last)
+    del_b = jnp.stack([prev_b, next_b], axis=1)
+    del_t = jnp.stack([
+        jnp.where(mid[:, None], ctx_of(prev_b, next_b), zeros4),
+        jnp.where((pos < org_last)[:, None], trans_p1, zeros4),
+    ], axis=1)
+    ins_b = jnp.stack([prev_b, nb], axis=1)
+    ins_t = jnp.stack([
+        jnp.where((pos > 0)[:, None], ctx_prev_nb, zeros4),
+        jnp.where((pos < L)[:, None], ctx_of(nb, cur_b), zeros4),
+    ], axis=1)
+
+    mtype = jnp.asarray(mtype, jnp.int32)
+    is_sub = (mtype == SUB)[:, None]
+    is_ins = (mtype == INS)[:, None]
+    bases = jnp.where(is_sub, sub_b, jnp.where(is_ins, ins_b, del_b))
+    transp = jnp.where(is_sub[:, :, None], sub_t,
+                       jnp.where(is_ins[:, :, None], ins_t, del_t))
+    shift = jnp.where(mtype == SUB, 0, jnp.where(mtype == INS, -1, 1)).astype(jnp.int32)
+    return MutationPatch(bases, transp, shift)
+def mutated_windows_per_pair(wt_e, wtr_e, wlens_e, p, mtype,
+                             patch: MutationPatch):
+    """Dense mutated windows for (E,) pairs each with its own window.
+
+    wt_e: (E, Jm) int32; wtr_e: (E, Jm, 4); wlens_e/p/mtype: (E,);
+    patch leaves (E, 2)/(E, 2, 4)/(E,).  Static-shift, gather-free."""
+    E, Jm = wt_e.shape
+    idx = jnp.arange(Jm, dtype=jnp.int32)[None, :]
+    p2 = p[:, None]
+    tpl_f = wt_e.astype(jnp.float32)
+
+    def sh_cols(x, t):
+        """x[..., clip(col+t, 0, Jm-1), ...] along the window axis."""
+        if t == 0:
+            return x
+        if t > 0:
+            tail = jnp.repeat(x[:, Jm - 1:], t, axis=1)
+            return jnp.concatenate([x[:, t:], tail], axis=1)
+        head = jnp.repeat(x[:, 0:1], -t, axis=1)
+        return jnp.concatenate([head, x[:, :t]], axis=1)
+
+    sh = patch.shift[:, None]
+    shifted_b = jnp.where(sh == -1, sh_cols(tpl_f, -1),
+                          jnp.where(sh == 1, sh_cols(tpl_f, 1), tpl_f))
+    sh3 = patch.shift[:, None, None]
+    shifted_t = jnp.where(sh3 == -1, sh_cols(wtr_e, -1),
+                          jnp.where(sh3 == 1, sh_cols(wtr_e, 1), wtr_e))
+    bases = jnp.where(idx <= p2, tpl_f, shifted_b)
+    trans = jnp.where((idx <= p2)[:, :, None], wtr_e, shifted_t)
+    bases = jnp.where(idx == p2 - 1, patch.bases[:, 0:1].astype(jnp.float32), bases)
+    bases = jnp.where(idx == p2, patch.bases[:, 1:2].astype(jnp.float32), bases)
+    trans = jnp.where((idx == p2 - 1)[:, :, None], patch.trans[:, 0][:, None, :], trans)
+    trans = jnp.where((idx == p2)[:, :, None], patch.trans[:, 1][:, None, :], trans)
+
+    ld = jnp.where(mtype == INS, 1, jnp.where(mtype == DEL, -1, 0))
+    new_len = wlens_e + ld
+    valid = idx < new_len[:, None]
+    bases = jnp.where(valid, bases, 4.0).astype(jnp.int8)
+    trans = jnp.where((valid & (idx < new_len[:, None] - 1))[:, :, None], trans, 0.0)
+    return bases, trans, new_len

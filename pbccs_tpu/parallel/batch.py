@@ -40,6 +40,7 @@ from pbccs_tpu.models.arrow.scorer import (
     ADD_POOR_ZSCORE,
     ADD_SUCCESS,
     _AB_MISMATCH_TOL,
+    _MAX_BAND_SHIFT,
     fill_alpha_beta_batch,
     fills_use_pallas,
     interior_read_scores,
@@ -51,8 +52,7 @@ from pbccs_tpu.ops.mutation_score import (
     INS,
     SUB,
     MutationPatch,
-    full_refill_score,
-    make_patch,
+    make_patches_fast,
 )
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
 
@@ -125,8 +125,7 @@ def _batch_patches(tpl32, trans, table, L, pos, mtype, base):
     """(Z, M) virtual-mutation patches on one oriented template track."""
 
     def one_zmw(t, tr, tb, l, p1, mt1, b1):
-        return jax.vmap(lambda p, mt, b: make_patch(t, tr, tb, l, p, mt, b))(
-            p1, mt1, b1)
+        return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
 
     return jax.vmap(one_zmw)(tpl32, trans, table, L, pos, mtype, base)
 
@@ -170,19 +169,20 @@ def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
                              patches_f, patches_r, int_mask)
 
 
-@functools.partial(jax.jit, static_argnames=("width",))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
 def _batch_edge(reads, rlens, win_tpl, win_trans, wlens,
-                zidx, ridx, pw, mt, pb, ptr, psh, width: int):
-    """(E,) absolute LLs of edge (read, mutation) pairs via full refill."""
+                zidx, ridx, pw, mt, pb, ptr, psh, width: int,
+                use_pallas: bool):
+    """(E,) absolute LLs of edge (read, mutation) pairs via full refill.
 
-    def one(z, r, p, t, b, tr, sh):
-        read = reads[z, r].astype(jnp.int32)
-        return full_refill_score(read, rlens[z, r],
-                                 win_tpl[z, r].astype(jnp.int32),
-                                 win_trans[z, r], wlens[z, r],
-                                 p, t, MutationPatch(b, tr, sh), width)
-
-    return jax.vmap(one)(zidx, ridx, pw, mt, pb, ptr, psh)
+    Flattens (Z, R) and delegates to the scorer's batched edge program
+    (one-hot row selects + dense mutated windows + batched fills)."""
+    Z, R = reads.shape[:2]
+    flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
+    from pbccs_tpu.models.arrow.scorer import _score_edge
+    return _score_edge.__wrapped__(
+        flat(reads), flat(rlens), flat(win_tpl), flat(win_trans), flat(wlens),
+        zidx * R + ridx, pw, mt, pb, ptr, psh, width, use_pallas)
 
 
 class BatchPolisher:
@@ -303,6 +303,9 @@ class BatchPolisher:
         self._ll_var = np.asarray(var, np.float64)
         mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
         mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
+        # see ArrowMultiReadScorer._rebuild: band-shift overflow drop
+        mated &= self._rlens <= _MAX_BAND_SHIFT * np.maximum(
+            self._tends - self._tstarts, 1)
 
         real = np.zeros((self._Z, self._R), bool)
         for z in range(self.n_zmws):
@@ -367,7 +370,9 @@ class BatchPolisher:
         ez, er, em = np.nonzero(edge_mask)
         if len(ez):
             E = len(ez)
-            Epad = pad_to(E, 64)
+            Epad = 64
+            while Epad < E:
+                Epad *= 2  # pow2 buckets keep the edge program's shape set small
             zi = np.zeros(Epad, np.int32)
             ri = np.zeros(Epad, np.int32)
             pp = np.zeros(Epad, np.int32)
@@ -393,7 +398,8 @@ class BatchPolisher:
                 self.win_tpl, self.win_trans, self.wlens,
                 jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
                 jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),
-                jnp.asarray(psh), self._W), np.float64)[:E]
+                jnp.asarray(psh), self._W,
+                fills_use_pallas() and self.mesh is None), np.float64)[:E]
             np.add.at(totals, (ez, em), edge_ll - self.baselines[ez, er])
 
         return totals
@@ -508,6 +514,13 @@ class BatchPolisher:
                     nxt = mutlib.apply_mutations(self.tpls[z], best)
                     if hash(nxt.tobytes()) in history[z]:
                         best = [max(best, key=lambda m: m.score)]
+                # single-mutation cycles (insert<->delete of one base with a
+                # near-zero score estimate) terminate as non-convergent
+                if hash(mutlib.apply_mutations(self.tpls[z], best).tobytes()) \
+                        in history[z]:
+                    done[z] = True
+                    best_per_zmw.append([])
+                    continue
                 history[z].add(hash(self.tpls[z].tobytes()))
                 results[z].n_applied += len(best)
                 best_per_zmw.append(best)
