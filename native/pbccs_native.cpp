@@ -1,0 +1,209 @@
+// Native host-runtime components for pbccs_tpu.
+//
+// TPU-native re-implementations of the reference's C++ host layers:
+//  * BGZF block codec (the reference delegates BAM IO to pbbam/htslib;
+//    here the hot (de)compression path is multithreaded over 64KB BGZF
+//    blocks, which htslib also does in its bgzf_mt mode).
+//  * Sparse-DP seed chaining (reference include/pacbio/ccs/ChainSeeds.h +
+//    src/ChainSeeds.cpp sweep-line SDP), same link-gain semantics as
+//    pbccs_tpu.align.seeds.chain_seeds, exposed for the host draft stage.
+//
+// Exposed as a plain C ABI consumed via ctypes (pbccs_tpu/native.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr int kBlockPayload = 64 * 1024 - 512;  // matches io/bam.py _MAX_BLOCK
+
+// one BGZF block: gzip member with BC extra subfield carrying BSIZE
+bool CompressBlock(const uint8_t* data, size_t len, int level,
+                   std::vector<uint8_t>* out) {
+  uLong bound = compressBound(len) + 64;
+  std::vector<uint8_t> payload(bound);
+  z_stream zs{};
+  if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK)
+    return false;
+  zs.next_in = const_cast<Bytef*>(data);
+  zs.avail_in = len;
+  zs.next_out = payload.data();
+  zs.avail_out = payload.size();
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return false;
+  size_t clen = zs.total_out;
+
+  static const uint8_t kHeader[16] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0,
+                                      0,    0xff, 0x06, 0,    0x42, 0x43,
+                                      0x02, 0};
+  size_t total = 16 + 2 + clen + 8;
+  out->resize(total);
+  std::memcpy(out->data(), kHeader, 16);
+  uint16_t bsize = static_cast<uint16_t>(total - 1);
+  (*out)[16] = bsize & 0xff;
+  (*out)[17] = bsize >> 8;
+  std::memcpy(out->data() + 18, payload.data(), clen);
+  uint32_t crc = crc32(0, data, len);
+  uint32_t isize = static_cast<uint32_t>(len);
+  uint8_t* tail = out->data() + 18 + clen;
+  for (int b = 0; b < 4; ++b) tail[b] = (crc >> (8 * b)) & 0xff;
+  for (int b = 0; b < 4; ++b) tail[4 + b] = (isize >> (8 * b)) & 0xff;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Compress `len` bytes into consecutive BGZF blocks of kBlockPayload bytes
+// using `nthreads` workers.  Returns the number of bytes written to `out`
+// (capacity `out_cap`), or -1 on failure / insufficient capacity.
+int64_t pbccs_bgzf_compress(const uint8_t* data, int64_t len, int level,
+                            int nthreads, uint8_t* out, int64_t out_cap) {
+  if (len < 0) return -1;
+  size_t nblocks = (len + kBlockPayload - 1) / kBlockPayload;
+  if (nblocks == 0) return 0;
+  std::vector<std::vector<uint8_t>> blocks(nblocks);
+  std::vector<char> ok(nblocks, 1);
+  nthreads = std::max(1, std::min<int>(nthreads, nblocks));
+
+  auto worker = [&](size_t t) {
+    for (size_t b = t; b < nblocks; b += nthreads) {
+      size_t off = b * static_cast<size_t>(kBlockPayload);
+      size_t n = std::min<size_t>(kBlockPayload, len - off);
+      if (!CompressBlock(data + off, n, level, &blocks[b])) ok[b] = 0;
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int t = 1; t < nthreads; ++t) threads.emplace_back(worker, t);
+  worker(0);
+  for (auto& th : threads) th.join();
+
+  int64_t total = 0;
+  for (size_t b = 0; b < nblocks; ++b) {
+    if (!ok[b]) return -1;
+    total += blocks[b].size();
+  }
+  if (total > out_cap) return -1;
+  uint8_t* p = out;
+  for (auto& blk : blocks) {
+    std::memcpy(p, blk.data(), blk.size());
+    p += blk.size();
+  }
+  return total;
+}
+
+// Decompress a BGZF byte stream (concatenated blocks; the 28-byte EOF
+// block decodes to zero bytes).  Returns bytes written, -1 on malformed
+// input, or -2 when out_cap is too small (retryable).
+int64_t pbccs_bgzf_decompress(const uint8_t* data, int64_t len, uint8_t* out,
+                              int64_t out_cap) {
+  int64_t ip = 0, op = 0;
+  while (ip + 18 <= len) {
+    if (data[ip] != 0x1f || data[ip + 1] != 0x8b) return -1;
+    uint16_t xlen = data[ip + 10] | (data[ip + 11] << 8);
+    // find BC subfield for BSIZE
+    int64_t xoff = ip + 12;
+    int64_t bsize = -1;
+    int64_t xend = xoff + xlen;
+    while (xoff + 4 <= xend) {
+      uint8_t si1 = data[xoff], si2 = data[xoff + 1];
+      uint16_t slen = data[xoff + 2] | (data[xoff + 3] << 8);
+      if (si1 == 'B' && si2 == 'C' && slen == 2)
+        bsize = (data[xoff + 4] | (data[xoff + 5] << 8)) + 1;
+      xoff += 4 + slen;
+    }
+    if (bsize < 0 || ip + bsize > len) return -1;
+    int64_t cdata_off = ip + 12 + xlen;
+    int64_t cdata_len = bsize - 12 - xlen - 8;
+    if (cdata_len < 0 || cdata_off + cdata_len + 8 > ip + bsize) return -1;
+    uint32_t isize = data[ip + bsize - 4] | (data[ip + bsize - 3] << 8) |
+                     (data[ip + bsize - 2] << 16) | (data[ip + bsize - 1] << 24);
+    if (op + isize > out_cap) return -2;  // under-capacity, caller may retry
+    if (isize > 0) {
+      z_stream zs{};
+      if (inflateInit2(&zs, -15) != Z_OK) return -1;
+      zs.next_in = const_cast<Bytef*>(data + cdata_off);
+      zs.avail_in = cdata_len;
+      zs.next_out = out + op;
+      zs.avail_out = out_cap - op;
+      int rc = inflate(&zs, Z_FINISH);
+      inflateEnd(&zs);
+      if (rc != Z_STREAM_END || zs.total_out != isize) return -1;
+    }
+    op += isize;
+    ip += bsize;
+  }
+  return (ip == len || ip == len - 0) ? op : -1;
+}
+
+// Sparse-DP seed chaining; same semantics as align.seeds.chain_seeds:
+// seeds (h[i], v[i]), chain gain mr*matches - |d_diag| - indels, links only
+// to strictly earlier rows with h_b < h_a, ties -> nearest predecessor in
+// (v, h)-sorted order.  Writes the chained (h, v) pairs; returns length.
+int32_t pbccs_chain_seeds(const int32_t* h, const int32_t* v, int32_t n,
+                          int32_t k, int32_t match_reward, int32_t* out_h,
+                          int32_t* out_v) {
+  if (n <= 0) return 0;
+  std::vector<int32_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  std::stable_sort(idx.begin(), idx.end(), [&](int a, int b) {
+    if (v[a] != v[b]) return v[a] < v[b];
+    return h[a] < h[b];
+  });
+  std::vector<int64_t> H(n), V(n), D(n), score(n);
+  std::vector<int32_t> pred(n, -1);
+  for (int i = 0; i < n; ++i) {
+    H[i] = h[idx[i]];
+    V[i] = v[idx[i]];
+    D[i] = H[i] - V[i];
+    score[i] = k;
+  }
+  int row_start = 0;
+  for (int a = 0; a < n; ++a) {
+    if (V[a] != V[row_start]) row_start = a;
+    int64_t best_score = 0;
+    int32_t best = -1;
+    for (int b = row_start - 1; b >= 0; --b) {  // reverse: nearest wins ties
+      if (H[b] >= H[a]) continue;
+      int64_t fwd = std::min(H[a] - H[b], V[a] - V[b]);
+      int64_t matches = k - std::max<int64_t>(0, k - fwd);
+      int64_t link = match_reward * matches - std::llabs(D[a] - D[b]) -
+                     (fwd - matches);
+      int64_t cand = score[b] + link;
+      if (cand > best_score) {
+        best_score = cand;
+        best = b;
+      }
+    }
+    if (best >= 0 && best_score > 0) {
+      score[a] = best_score;
+      pred[a] = best;
+    }
+  }
+  int32_t end = -1;
+  int64_t best_end = -1;
+  for (int i = 0; i < n; ++i)
+    if (pred[i] >= 0 && score[i] > best_end) {
+      best_end = score[i];
+      end = i;
+    }
+  if (end < 0) return 0;
+  std::vector<int32_t> chain;
+  for (int32_t cur = end; cur >= 0; cur = pred[cur]) chain.push_back(cur);
+  std::reverse(chain.begin(), chain.end());
+  for (size_t i = 0; i < chain.size(); ++i) {
+    out_h[i] = static_cast<int32_t>(H[chain[i]]);
+    out_v[i] = static_cast<int32_t>(V[chain[i]]);
+  }
+  return static_cast<int32_t>(chain.size());
+}
+
+}  // extern "C"

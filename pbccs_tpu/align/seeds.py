@@ -75,7 +75,9 @@ def chain_seeds(seeds: np.ndarray, k: int,
                 match_reward: int = 3) -> np.ndarray:
     """Best positive-gain chain through the seeds (reference ChainSeeds,
     ChainSeeds.cpp:203-361; LinkScore at :104-122).  Returns the chained
-    subset of `seeds`, in chain order.
+    subset of `seeds`, in chain order.  Dispatches to the native C++
+    implementation (native/pbccs_native.cpp) when built; the numpy path
+    below is the reference implementation.
 
     Seeds in the same row (equal pos2) never link to each other; a link's
     gain is matchReward*matches - indels - mismatches over the implied
@@ -83,6 +85,10 @@ def chain_seeds(seeds: np.ndarray, k: int,
     n = len(seeds)
     if n == 0:
         return np.zeros((0, 2), np.int32)
+    from pbccs_tpu import native
+    nat = native.chain_seeds(seeds, k, match_reward)
+    if nat is not None:
+        return nat
     s = seeds[np.lexsort((seeds[:, 0], seeds[:, 1]))].astype(np.int64)
     H, V = s[:, 0], s[:, 1]
     diag = H - V

@@ -258,9 +258,22 @@ def run(argv: list[str] | None = None) -> int:
                            program_lines=[
                                f"@PG\tID:ccs-{__version__}\tPN:ccs\t"
                                f"VN:{__version__}"])
+        # companion .pbi, as the reference's PbiBuilder does alongside the
+        # output BAM (reference src/main/ccs.cpp:120, 380)
+        from pbccs_tpu.io.pbi import PbiBuilder, read_group_numeric_id
+        uposs = []
         with BamWriter(args.output, header) as bw:
             for result in tally.results:
-                bw.write(writer_record(result))
+                uposs.append(bw.write(writer_record(result)))
+            bw_handle = bw
+        with PbiBuilder(args.output + ".pbi") as pbi:
+            for result, upos in zip(tally.results, uposs):
+                movie = result.id.split("/")[0]
+                hole = int(result.id.split("/")[1])
+                pbi.add_record(
+                    read_group_numeric_id(make_read_group_id(movie, "CCS")),
+                    -1, -1, hole, result.predicted_accuracy, 0,
+                    bw_handle.voffset(upos))
 
     with open(args.reportFile, "w") as rf:
         write_results_report(rf, tally)

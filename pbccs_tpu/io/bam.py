@@ -32,9 +32,48 @@ class BgzfWriter:
     def __init__(self, fh: BinaryIO):
         self._fh = fh
         self._buf = bytearray()
+        self._upos = 0            # total uncompressed bytes accepted
+        self._cpos = 0            # total compressed bytes emitted
+        self._block_comp_starts: list[int] = []  # comp offset of each block
+
+    def utell(self) -> int:
+        """Total uncompressed bytes written so far (all blocks are exactly
+        _MAX_BLOCK payload except the final one, so an uncompressed offset
+        resolves to a BGZF virtual offset after close via voffset())."""
+        return self._upos
+
+    def voffset(self, upos: int) -> int:
+        """BGZF virtual file offset (coffset << 16 | uoffset) of the
+        uncompressed position `upos`; valid after the block containing it
+        is flushed (always true after close())."""
+        blk = upos // _MAX_BLOCK
+        if blk >= len(self._block_comp_starts):
+            raise ValueError(
+                f"uncompressed offset {upos} is in a block that has not been "
+                "flushed yet; resolve virtual offsets after close()")
+        return (self._block_comp_starts[blk] << 16) | (upos - blk * _MAX_BLOCK)
 
     def write(self, data: bytes) -> None:
+        self._upos += len(data)
         self._buf += data
+        if len(self._buf) >= 4 * _MAX_BLOCK:
+            # batch path: the native codec compresses whole-block runs
+            # across threads (native/pbccs_native.cpp)
+            from pbccs_tpu import native
+            nblocks = len(self._buf) // _MAX_BLOCK
+            chunk = bytes(self._buf[: nblocks * _MAX_BLOCK])
+            packed = native.bgzf_compress(chunk)
+            if packed is not None:
+                # walk the packed blocks to record their compressed starts
+                off = 0
+                while off < len(packed):
+                    self._block_comp_starts.append(self._cpos + off)
+                    bsize = packed[off + 16] | (packed[off + 17] << 8)
+                    off += bsize + 1
+                self._fh.write(packed)
+                self._cpos += len(packed)
+                del self._buf[: nblocks * _MAX_BLOCK]
+                return
         while len(self._buf) >= _MAX_BLOCK:
             self._flush_block(self._buf[:_MAX_BLOCK])
             del self._buf[:_MAX_BLOCK]
@@ -43,6 +82,8 @@ class BgzfWriter:
         co = zlib.compressobj(6, zlib.DEFLATED, -15)
         comp = co.compress(bytes(chunk)) + co.flush()
         bsize = len(comp) + len(_BGZF_HEADER) + 2 + 8  # +BSIZE +CRC/ISIZE
+        self._block_comp_starts.append(self._cpos)
+        self._cpos += bsize
         self._fh.write(_BGZF_HEADER)
         self._fh.write(struct.pack("<H", bsize - 1))
         self._fh.write(comp)
@@ -261,7 +302,10 @@ class BamWriter:
         self._bgzf.write(b"BAM\x01" + struct.pack("<i", len(text)) + text
                          + struct.pack("<i", 0))
 
-    def write(self, rec: BamRecord) -> None:
+    def write(self, rec: BamRecord) -> int:
+        """Write one record; returns its uncompressed stream offset (resolve
+        to a .pbi virtual file offset with `voffset()` after close)."""
+        upos = self._bgzf.utell()
         name = rec.name.encode() + b"\x00"
         seq = rec.seq.upper()
         l_seq = len(seq)
@@ -280,6 +324,10 @@ class BamWriter:
                            rec.flag, l_seq, -1, -1, 0)
         body += name + bytes(packed) + qual + tags
         self._bgzf.write(struct.pack("<i", len(body)) + body)
+        return upos
+
+    def voffset(self, upos: int) -> int:
+        return self._bgzf.voffset(upos)
 
     def close(self) -> None:
         self._bgzf.close()

@@ -61,15 +61,15 @@ def refine_consensus(scorer: ArrowMultiReadScorer,
         best = mutlib.best_subset(favorable, opts.mutation_separation)
 
         # cycle avoidance (Consensus-inl.hpp:229-241)
-        if len(best) > 1:
+        next_tpl = mutlib.apply_mutations(scorer.tpl, best)
+        if len(best) > 1 and hash(next_tpl.tobytes()) in tpl_history:
+            best = [max(best, key=lambda m: m.score)]
             next_tpl = mutlib.apply_mutations(scorer.tpl, best)
-            if hash(next_tpl.tobytes()) in tpl_history:
-                best = [max(best, key=lambda m: m.score)]
         # a single marginal mutation can also cycle (insert<->delete at one
         # position when the extend+link estimate sits near zero); a repeated
         # template terminates as non-convergent rather than burning the
         # whole iteration budget
-        if hash(mutlib.apply_mutations(scorer.tpl, best).tobytes()) in tpl_history:
+        if hash(next_tpl.tobytes()) in tpl_history:
             break
 
         res.n_applied += len(best)

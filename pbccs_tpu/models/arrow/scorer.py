@@ -36,6 +36,7 @@ from pbccs_tpu.ops.fwdbwd import (
     forward_loglik,
 )
 from pbccs_tpu.ops.fwdbwd_pallas import _MAX_SHIFT as _MAX_BAND_SHIFT, fills_use_pallas
+from pbccs_tpu.utils import next_pow2 as _next_pow2
 from pbccs_tpu.ops.mutation_score import (
     DEL,
     INS,
@@ -53,11 +54,7 @@ ADD_SUCCESS, ADD_ALPHABETAMISMATCH, ADD_MEM_FAIL, ADD_POOR_ZSCORE, ADD_OTHER = r
 _AB_MISMATCH_TOL = 1e-3  # reference SimpleRecursor.cpp:53
 
 
-def _next_pow2(n: int, lo: int = 8) -> int:
-    v = lo
-    while v < n:
-        v *= 2
-    return v
+
 
 
 def oriented_window(strand, ts, te, tpl_f, trans_f, tpl_r, trans_r, L):

@@ -1,0 +1,143 @@
+"""Edna: channel-space pulse evaluator (experimental basecaller-adjacent
+model; reference ConsensusCore/include/ConsensusCore/Edna/EdnaEvaluator.hpp,
+EdnaConfig.hpp:46-67).  Not used by the CCS pipeline; exported for API
+parity with the reference's SWIG surface.
+
+The model works on channel observations (1..4; 0 = dark/deletion) against a
+channel-space template: per template base a stay probability pStay, a merge
+probability pMerge (when the next template channel matches), and move/stay
+emission distributions over the 5 observation symbols.  Move scores are
+log-space, matching QvEvaluator's interface so the Quiver recursor
+machinery applies."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EdnaModelParams:
+    """pStay/pMerge per template base (4,), move/stay emission tables
+    (4, 5) over observations {0=dark, 1..4=channels}
+    (reference EdnaConfig.hpp:46-67)."""
+
+    p_stay: tuple
+    p_merge: tuple
+    move_dists: tuple     # flattened (4, 5) row-major, as in the reference
+    stay_dists: tuple
+
+    def move_dist(self, tpl_base: int, obs: int) -> float:
+        return self.move_dists[(tpl_base - 1) * 5 + obs]
+
+    def stay_dist(self, tpl_base: int, obs: int) -> float:
+        return self.stay_dists[(tpl_base - 1) * 5 + obs]
+
+
+class EdnaEvaluator:
+    """Move scores for one (channel read, channel template) pair
+    (reference EdnaEvaluator.hpp:70-262)."""
+
+    def __init__(self, channels: np.ndarray, channel_tpl: np.ndarray,
+                 params: EdnaModelParams, pin_start: bool = True,
+                 pin_end: bool = True):
+        self.channels = np.asarray(channels, np.int32)
+        self.tpl = np.asarray(channel_tpl, np.int32)
+        self.params = params
+        self.pin_start = pin_start
+        self.pin_end = pin_end
+
+    def read_length(self) -> int:
+        return len(self.channels)
+
+    def template_length(self) -> int:
+        return len(self.tpl)
+
+    def _tpl_base(self, j: int) -> int:
+        return int(self.tpl[j]) if j < len(self.tpl) else 1
+
+    def _p_stay(self, j: int) -> float:
+        return self.params.p_stay[self._tpl_base(j) - 1]
+
+    def _p_merge(self, j: int) -> float:
+        if j < len(self.tpl) - 1 and self.tpl[j] == self.tpl[j + 1]:
+            return self.params.p_merge[self._tpl_base(j) - 1]
+        return 0.0
+
+    def is_match(self, i: int, j: int) -> bool:
+        return bool(self.channels[i] == self.tpl[j])
+
+    def inc(self, i: int, j: int) -> float:
+        ps = self._p_stay(j)
+        pm = (1.0 - ps) * self._p_merge(j)
+        trans = 1.0 - ps - pm
+        em = self.params.move_dist(self._tpl_base(j), int(self.channels[i]))
+        return float(np.log(trans * em))
+
+    def delete(self, i: int, j: int) -> float:
+        if (not self.pin_start and i == 0) or \
+                (not self.pin_end and i == self.read_length()):
+            return 0.0
+        ps = self._p_stay(j)
+        pm = (1.0 - ps) * self._p_merge(j)
+        trans = 1.0 - ps - pm
+        em = self.params.move_dist(self._tpl_base(j), 0)
+        return float(np.log(trans * em))
+
+    def extra(self, i: int, j: int) -> float:
+        trans = self._p_stay(j)
+        em = self.params.stay_dist(self._tpl_base(j), int(self.channels[i]))
+        return float(np.log(trans * em))
+
+    def merge(self, i: int, j: int) -> float:
+        """Merge move score, *including* the pulse emission so merge() and
+        score_move(j, j+2, obs) agree.  (Documented deviation: the
+        reference's Edna Merge() drops the emission term,
+        EdnaEvaluator.hpp:222-237, which disagrees with its own ScoreMove
+        and leaves the forward probability unnormalized; Edna is flagged
+        experimental there.)"""
+        if not (j < len(self.tpl) - 1 and self.channels[i] == self.tpl[j]
+                and self.channels[i] == self.tpl[j + 1]):
+            return -np.inf
+        ps = self._p_stay(j)
+        pm = (1.0 - ps) * self._p_merge(j)
+        em = self.params.move_dist(self._tpl_base(j + 1), int(self.channels[i]))
+        return float(np.log(pm * em))
+
+    def score_move(self, j1: int, j2: int, obs: int) -> float:
+        """Transition+emission log score for moving template j1 -> j2 while
+        observing `obs` (reference EdnaEvaluator.hpp:239-262)."""
+        ps = self._p_stay(j1)
+        pm = (1.0 - ps) * self._p_merge(j1)
+        if j1 == j2:
+            return float(np.log(ps * self.params.stay_dist(self._tpl_base(j1), obs)))
+        if j1 + 1 == j2:
+            trans = 1.0 - ps - pm
+            return float(np.log(trans * self.params.move_dist(self._tpl_base(j1), obs)))
+        if j1 + 2 == j2:
+            return float(np.log(pm * self.params.move_dist(self._tpl_base(j1 + 1), obs)))
+        raise ValueError("moves advance the template by 0, 1 or 2")
+
+    def loglik(self) -> float:
+        """Dense forward log-likelihood over the full move set (the Edna
+        counterpart of the Quiver dense oracle)."""
+        I, J = self.read_length(), self.template_length()
+        a = np.full((I + 1, J + 1), -np.inf)
+        a[0, 0] = 0.0
+        for j in range(J + 1):
+            for i in range(I + 1):
+                terms = []
+                if i == 0 and j == 0:
+                    continue
+                if i > 0 and j > 0:
+                    terms.append(a[i - 1, j - 1] + self.inc(i - 1, j - 1))
+                if i > 0 and j <= J:
+                    terms.append(a[i - 1, j] + self.extra(i - 1, min(j, J - 1)))
+                if j > 0:
+                    terms.append(a[i, j - 1] + self.delete(i, j - 1))
+                if i > 0 and j > 1:
+                    terms.append(a[i - 1, j - 2] + self.merge(i - 1, j - 2))
+                if terms:
+                    a[i, j] = np.logaddexp.reduce(terms)
+        return float(a[I, J])

@@ -1,0 +1,22 @@
+"""Quiver model family: the QV-feature-based consensus model (the
+reference's legacy float/SSE path, ConsensusCore/include/ConsensusCore/
+Quiver).  Arrow (models.arrow) is the CCS production path; Quiver is kept
+at full capability for GenomicConsensus-style workflows that supply
+per-base QV feature tracks."""
+
+from pbccs_tpu.models.quiver.params import (  # noqa: F401
+    ALL_MOVES,
+    BASIC_MOVES,
+    BandingOptions,
+    QuiverConfig,
+    QuiverConfigTable,
+    QvModelParams,
+)
+from pbccs_tpu.models.quiver.features import QvSequenceFeatures  # noqa: F401
+from pbccs_tpu.models.quiver.recursor import (  # noqa: F401
+    quiver_forward,
+    quiver_backward,
+    quiver_loglik,
+    quiver_loglik_backward,
+)
+from pbccs_tpu.models.quiver.scorer import QuiverMultiReadScorer  # noqa: F401

@@ -1,0 +1,50 @@
+"""QV feature tracks for the Quiver model (reference
+ConsensusCore/include/ConsensusCore/Features.hpp:50-123: QvSequenceFeatures
+= sequence + InsQV, SubsQV, DelQV, DelTag, MergeQV)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from pbccs_tpu.models.arrow.params import encode_bases
+
+
+@dataclasses.dataclass
+class QvSequenceFeatures:
+    """One read's base codes + 5 per-base QV tracks.
+
+    seq: int8 base codes (0..3; 4 = N); qv tracks: float32, one value per
+    base.  del_tag is a base *code* track (the likely deleted base before
+    each position), compared against template bases by Del()."""
+
+    seq: np.ndarray
+    ins_qv: np.ndarray
+    subs_qv: np.ndarray
+    del_qv: np.ndarray
+    del_tag: np.ndarray
+    merge_qv: np.ndarray
+
+    def __post_init__(self) -> None:
+        n = len(self.seq)
+        for name in ("ins_qv", "subs_qv", "del_qv", "del_tag", "merge_qv"):
+            if len(getattr(self, name)) != n:
+                raise ValueError(f"feature track {name} length != sequence length")
+
+    @classmethod
+    def from_str(cls, seq: str, ins_qv=None, subs_qv=None, del_qv=None,
+                 del_tag=None, merge_qv=None) -> "QvSequenceFeatures":
+        codes = encode_bases(seq)
+        n = len(codes)
+        zeros = lambda: np.zeros(n, np.float32)
+        return cls(codes,
+                   np.asarray(ins_qv, np.float32) if ins_qv is not None else zeros(),
+                   np.asarray(subs_qv, np.float32) if subs_qv is not None else zeros(),
+                   np.asarray(del_qv, np.float32) if del_qv is not None else zeros(),
+                   np.asarray(del_tag, np.float32) if del_tag is not None
+                   else np.full(n, 4, np.float32),
+                   np.asarray(merge_qv, np.float32) if merge_qv is not None else zeros())
+
+    def __len__(self) -> int:
+        return len(self.seq)

@@ -1,0 +1,67 @@
+"""One-shot read-vs-template scoring convenience.
+
+Parity: Arrow/Quiver ReadScorer (reference ConsensusCore/include/
+ConsensusCore/Arrow/ReadScorer.hpp:50-74, src/C++/Arrow/ReadScorer.cpp and
+the Quiver-namespace twin): construct the banded forward matrix for one
+(read, template) pair and return the log-likelihood, without standing up a
+multi-read scorer."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from pbccs_tpu.models.arrow.params import ArrowConfig, encode_bases, \
+    snr_to_transition_table_host, template_transition_params
+from pbccs_tpu.ops.fwdbwd import banded_forward, forward_loglik
+from pbccs_tpu.utils import next_pow2
+
+
+def _codes(seq) -> np.ndarray:
+    if isinstance(seq, str):
+        return encode_bases(seq)
+    return np.asarray(seq, np.int8)
+
+
+
+
+
+def score_read(read, template, snr, config: ArrowConfig | None = None) -> float:
+    """log P(read | template) under the Arrow pair-HMM
+    (ReadScorer::Score, Arrow/ReadScorer.cpp)."""
+    config = config or ArrowConfig()
+    read_c = _codes(read)
+    tpl_c = _codes(template)
+    imax = next_pow2(len(read_c) + 8)
+    jmax = next_pow2(len(tpl_c) + 8)
+    rpad = np.full(imax, 4, np.int8)
+    rpad[: len(read_c)] = read_c
+    tpad = np.full(jmax, 4, np.int8)
+    tpad[: len(tpl_c)] = tpl_c
+    table = jnp.asarray(snr_to_transition_table_host(np.asarray(snr, np.float64)),
+                        jnp.float32)
+    trans = template_transition_params(jnp.asarray(tpad), table,
+                                       jnp.int32(len(tpl_c)))
+    alpha = banded_forward(jnp.asarray(rpad), jnp.int32(len(read_c)),
+                           jnp.asarray(tpad), trans, jnp.int32(len(tpl_c)),
+                           config.banding.band_width)
+    return float(forward_loglik(alpha, len(read_c), len(tpl_c)))
+
+
+def score_read_quiver(features, template, config=None) -> float:
+    """log P(read | template) under the Quiver model
+    (Quiver/ReadScorer.cpp)."""
+    from pbccs_tpu.models.quiver.params import QuiverConfig
+    from pbccs_tpu.models.quiver.recursor import (
+        feature_arrays, quiver_forward, quiver_loglik)
+
+    config = config or QuiverConfig()
+    tpl_c = _codes(template)
+    imax = next_pow2(len(features) + 8)
+    jmax = next_pow2(len(tpl_c) + 8)
+    tpad = np.full(jmax, 4, np.int8)
+    tpad[: len(tpl_c)] = tpl_c
+    fa = feature_arrays(features, imax)
+    alpha = quiver_forward(fa, jnp.int32(len(features)), jnp.asarray(tpad),
+                           jnp.int32(len(tpl_c)), config)
+    return float(quiver_loglik(alpha, len(features), len(tpl_c)))

@@ -1,0 +1,123 @@
+"""ctypes bindings for the native host-runtime library (native/
+pbccs_native.cpp): multithreaded BGZF codec and sparse-DP seed chaining.
+
+The library is optional: every entry point has a pure-Python equivalent
+(io.bam zlib path, align.seeds.chain_seeds), so a missing or unbuildable
+.so degrades to the fallback silently.  Build with `make -C native`; the
+loader also tries an on-demand build once when a compiler is available
+(set PBCCS_NATIVE=0 to disable the native path entirely)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpbccs_native.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("PBCCS_NATIVE", "").strip().lower() in ("0", "false", "off", "no"):
+        return None
+    src = os.path.join(_NATIVE_DIR, "pbccs_native.cpp")
+    stale = (not os.path.exists(_LIB_PATH)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)))
+    if stale and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        try:
+            subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
+                           capture_output=True, timeout=120, check=False)
+        except Exception:
+            pass
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.pbccs_bgzf_compress.restype = ctypes.c_int64
+    lib.pbccs_bgzf_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int64]
+    lib.pbccs_bgzf_decompress.restype = ctypes.c_int64
+    lib.pbccs_bgzf_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+    lib.pbccs_chain_seeds.restype = ctypes.c_int32
+    lib.pbccs_chain_seeds.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def bgzf_compress(data: bytes, level: int = 6,
+                  nthreads: int | None = None) -> Optional[bytes]:
+    """Multithreaded BGZF compression of `data` (no EOF block appended);
+    None if the native library is unavailable or fails."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not data:
+        return b""
+    nthreads = nthreads or min(8, os.cpu_count() or 1)
+    cap = len(data) + (len(data) // (64 * 1024) + 2) * 1024 + 1024
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pbccs_bgzf_compress(data, len(data), level, nthreads, out, cap)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+def bgzf_decompress(data: bytes, expected_size: int | None = None) -> Optional[bytes]:
+    """Decompress a concatenated-BGZF-block byte stream; None on failure."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not data:
+        return b""
+    cap = expected_size if expected_size is not None else max(len(data) * 6, 1 << 20)
+    while True:
+        out = ctypes.create_string_buffer(cap)
+        n = lib.pbccs_bgzf_decompress(data, len(data), out, cap)
+        if n >= 0:
+            return out.raw[:n]
+        if n != -2 or expected_size is not None or cap > (1 << 31):
+            return None            # -1 = corrupt input; give up immediately
+        cap *= 4                   # -2 = under-capacity; grow and retry
+
+
+def chain_seeds(seeds: np.ndarray, k: int,
+                match_reward: int = 3) -> Optional[np.ndarray]:
+    """Native SDP chaining; same semantics as align.seeds.chain_seeds.
+    None if the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(seeds)
+    if n == 0:
+        return np.zeros((0, 2), np.int32)
+    h = np.ascontiguousarray(seeds[:, 0], np.int32)
+    v = np.ascontiguousarray(seeds[:, 1], np.int32)
+    out_h = np.zeros(n, np.int32)
+    out_v = np.zeros(n, np.int32)
+    m = lib.pbccs_chain_seeds(
+        h.ctypes.data_as(ctypes.c_void_p), v.ctypes.data_as(ctypes.c_void_p),
+        n, k, match_reward,
+        out_h.ctypes.data_as(ctypes.c_void_p),
+        out_v.ctypes.data_as(ctypes.c_void_p))
+    return np.stack([out_h[:m], out_v[:m]], axis=1)

@@ -55,6 +55,7 @@ from pbccs_tpu.ops.mutation_score import (
     make_patches_fast,
 )
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
+from pbccs_tpu.utils import next_pow2
 
 # mutation-axis chunk: every scoring call uses this static M so one compiled
 # program serves every refinement round and the QV sweep
@@ -370,9 +371,7 @@ class BatchPolisher:
         ez, er, em = np.nonzero(edge_mask)
         if len(ez):
             E = len(ez)
-            Epad = 64
-            while Epad < E:
-                Epad *= 2  # pow2 buckets keep the edge program's shape set small
+            Epad = next_pow2(E, 64)
             zi = np.zeros(Epad, np.int32)
             ri = np.zeros(Epad, np.int32)
             pp = np.zeros(Epad, np.int32)
@@ -510,14 +509,13 @@ class BatchPolisher:
                     best_per_zmw.append([])
                     continue
                 best = mutlib.best_subset(fav, opts.mutation_separation)
-                if len(best) > 1:
+                nxt = mutlib.apply_mutations(self.tpls[z], best)
+                if len(best) > 1 and hash(nxt.tobytes()) in history[z]:
+                    best = [max(best, key=lambda m: m.score)]
                     nxt = mutlib.apply_mutations(self.tpls[z], best)
-                    if hash(nxt.tobytes()) in history[z]:
-                        best = [max(best, key=lambda m: m.score)]
                 # single-mutation cycles (insert<->delete of one base with a
                 # near-zero score estimate) terminate as non-convergent
-                if hash(mutlib.apply_mutations(self.tpls[z], best).tobytes()) \
-                        in history[z]:
+                if hash(nxt.tobytes()) in history[z]:
                     done[z] = True
                     best_per_zmw.append([])
                     continue

@@ -290,3 +290,23 @@ class PoaGraph:
             v = best_prev[v]
         path.reverse()
         return path
+
+
+    def write_graphviz(self, fh, consensus_vertices=None) -> None:
+        """Dump the DAG in GraphViz dot format (parity:
+        PoaGraph::WriteGraphVizFile, reference ConsensusCore/src/C++/Poa/
+        PoaGraph.cpp / PoaGraphImpl::writeGraphVizFile): one node per
+        vertex labeled base/#reads, consensus-path vertices highlighted."""
+        from pbccs_tpu.models.arrow.params import BASES
+
+        on_path = set(consensus_vertices or ())
+        fh.write("digraph G {\n  rankdir=\"LR\";\n")
+        for v in range(len(self.base)):
+            base = BASES[self.base[v]] if 0 <= self.base[v] < 4 else "N"
+            style = ' style="filled", fillcolor="lightblue",' if v in on_path else ""
+            fh.write(f'  {v} [shape=Mrecord,{style} label="{{ {base} | '
+                     f'{self.nreads[v]} }}"];\n')
+        for v in range(len(self.base)):
+            for w in self.succs[v]:
+                fh.write(f"  {v} -> {w};\n")
+        fh.write("}\n")

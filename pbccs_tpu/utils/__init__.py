@@ -1,1 +1,10 @@
 """Shared host-side utilities (intervals, coverage, sequences, statistics)."""
+
+
+def next_pow2(n: int, lo: int = 8) -> int:
+    """Smallest power of two >= n, at least `lo` (shared padding-bucket
+    policy: pow2 buckets keep the set of compiled shapes small)."""
+    v = lo
+    while v < n:
+        v *= 2
+    return v

@@ -1,0 +1,92 @@
+"""Diploid caller, ReadScorer, Coverage, Binomial survival
+(reference TestDiploidQuiver.cpp / TestCoverage.cpp patterns)."""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.models.diploid import (
+    DiploidSite,
+    heterozygous_loglik,
+    homozygous_loglik,
+    is_site_heterozygous,
+)
+from pbccs_tpu.models.readscorer import score_read, score_read_quiver
+from pbccs_tpu.utils.coverage import coverage_in_window, covered_intervals
+from pbccs_tpu.utils.intervals import Interval
+from pbccs_tpu.utils.statistics import binomial_survival
+
+
+def test_homozygous_site_not_called():
+    # all reads strongly favor the no-op allele
+    scores = np.zeros((10, 9))
+    scores[:, 1:] = -20.0
+    assert is_site_heterozygous(scores, 0.0) is None
+
+
+def test_heterozygous_site_called_with_read_assignment():
+    # half the reads favor allele 0 (no-op), half favor allele 2 (same
+    # length diff 0), by a wide margin
+    scores = np.full((10, 9), -30.0)
+    scores[:5, 0] = 0.0
+    scores[5:, 2] = 0.0
+    site = is_site_heterozygous(scores, 0.0)
+    assert site is not None
+    assert {site.allele0, site.allele1} == {0, 2}
+    want = np.array([0] * 5 + [1] * 5) if site.allele0 == 0 else \
+        np.array([1] * 5 + [0] * 5)
+    np.testing.assert_array_equal(site.allele_for_read, want)
+    assert site.log_bayes_factor > 0
+
+
+def test_het_pairs_respect_length_diffs():
+    # alleles 0 (len 0) and 4 (len +1) can never pair
+    scores = np.full((6, 9), -30.0)
+    scores[:3, 0] = 0.0
+    scores[3:, 4] = 0.0
+    ll, a0, a1 = heterozygous_loglik(scores)
+    assert (a0, a1) != (0, 4)
+
+
+def test_hom_loglik_is_logsumexp_of_column_sums():
+    scores = np.array([[0.0, -1.0], [0.0, -1.0]])
+    got = homozygous_loglik(scores)
+    want = np.logaddexp(0.0, -2.0)
+    assert abs(got - want) < 1e-9
+
+
+def test_binomial_survival_matches_r_pbinom():
+    # pbinom(2, 10, 0.5, lower.tail=F) = 0.9453125
+    assert abs(binomial_survival(2, 10, 0.5) - 0.9453125) < 1e-9
+    assert abs(binomial_survival(9, 10, 0.5) - 0.5 ** 10) < 1e-12
+    assert binomial_survival(10, 10, 0.5) == 0.0
+    phred = binomial_survival(2, 10, 0.5, as_phred=True)
+    assert abs(phred - (-10 * np.log10(0.9453125))) < 1e-9
+
+
+def test_coverage_in_window_and_intervals():
+    ts = [0, 5, 5, 20]
+    te = [10, 15, 25, 30]
+    cov = coverage_in_window(ts, te, 0, 30)
+    assert cov[0] == 1 and cov[6] == 3 and cov[12] == 2 and cov[17] == 1
+    assert cov[22] == 2 and cov[26] == 1
+    ivs = covered_intervals(2, ts, te, 0, 30)
+    assert ivs == [Interval(5, 15), Interval(20, 25)]
+    assert covered_intervals(5, ts, te, 0, 30) == []
+
+
+def test_score_read_prefers_true_template(rng):
+    tpl = "".join(rng.choice(list("ACGT"), 60))
+    other = "".join(rng.choice(list("ACGT"), 60))
+    snr = np.array([8.0, 8.0, 8.0, 8.0])
+    s_true = score_read(tpl, tpl, snr)
+    s_other = score_read(tpl, other, snr)
+    assert s_true > s_other
+    assert s_true > -10
+
+
+def test_score_read_quiver_prefers_true_template(rng):
+    from pbccs_tpu.models.quiver import QvSequenceFeatures
+    tpl = "".join(rng.choice(list("ACGT"), 50))
+    other = "".join(rng.choice(list("ACGT"), 50))
+    feat = QvSequenceFeatures.from_str(tpl)
+    assert score_read_quiver(feat, tpl) > score_read_quiver(feat, other)

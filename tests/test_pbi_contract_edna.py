@@ -1,0 +1,135 @@
+"""PBI index round trip, tool-contract wrapper, Edna evaluator."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.io.bam import BamHeader, BamRecord, BamWriter, BamReader, \
+    BgzfReader, ReadGroupInfo, make_read_group_id
+from pbccs_tpu.io.pbi import PbiBuilder, PbiIndex, read_group_numeric_id
+from pbccs_tpu.models.edna import EdnaEvaluator, EdnaModelParams
+
+
+def test_pbi_roundtrip_and_virtual_offsets(tmp_path, rng):
+    bam_path = str(tmp_path / "x.bam")
+    pbi_path = bam_path + ".pbi"
+    hdr = BamHeader(read_groups=[ReadGroupInfo(movie_name="m", read_type="CCS")])
+    rgid = read_group_numeric_id(make_read_group_id("m", "CCS"))
+    seqs = ["".join(rng.choice(list("ACGT"), int(rng.integers(50, 2000))))
+            for _ in range(200)]
+    uposs = []
+    with BamWriter(bam_path, hdr) as w:
+        for i, s in enumerate(seqs):
+            uposs.append(w.write(BamRecord(name=f"m/{i}/ccs", seq=s,
+                                           tags={"zm": i})))
+    voffs = [w.voffset(u) for u in uposs]  # resolvable only after close
+    with PbiBuilder(pbi_path) as pbi:
+        for i, v in enumerate(voffs):
+            pbi.add_record(rgid, -1, -1, i, 0.99, 0, v)
+
+    idx = PbiIndex(pbi_path)
+    assert idx.n_reads == 200
+    np.testing.assert_array_equal(idx.holes, np.arange(200))
+    assert (idx.rg_ids == rgid).all()
+    assert idx.rows_for_zmw(123).tolist() == [123]
+    # virtual offsets must be monotone and resolve: seek into the BAM at a
+    # few offsets and re-read the record there
+    assert (np.diff(idx.offsets.astype(np.int64)) > 0).all()
+    with open(bam_path, "rb") as fh:
+        for i in (0, 57, 199):
+            voff = int(idx.offsets[i])
+            coff, uoff = voff >> 16, voff & 0xFFFF
+            fh.seek(coff)
+            rd = BgzfReader(fh)
+            rd.read(uoff)
+            import struct
+            (blen,) = struct.unpack("<i", rd.read(4))
+            body = rd.read(blen)
+            lname = body[8]
+            name = body[32: 32 + lname - 1].decode()
+            assert name == f"m/{i}/ccs"
+
+
+def test_tool_contract_emit_and_run(tmp_path):
+    from pbccs_tpu import contract
+    tc = contract.tool_contract()
+    assert tc["tool_contract"]["tool_id"] == "pbccs.tasks.ccs"
+    assert len(tc["tool_contract"]["task_options"]) == 6
+
+    # build a small input BAM of subreads via the simulator
+    from pbccs_tpu.simulate import simulate_zmw
+    from pbccs_tpu.models.arrow.params import BASES
+    rng = np.random.default_rng(5)
+    hdr = BamHeader(read_groups=[ReadGroupInfo(movie_name="mv", read_type="SUBREAD")])
+    in_bam = str(tmp_path / "subreads.bam")
+    with BamWriter(in_bam, hdr) as w:
+        for z in range(2):
+            tpl, reads, strands, snr = simulate_zmw(rng, 120, 5)
+            for i, r in enumerate(reads):
+                seq = "".join(BASES[c] for c in r)
+                w.write(BamRecord(
+                    name=f"mv/{z}/{i * 500}_{i * 500 + len(seq)}", seq=seq,
+                    tags={"zm": z, "sn": [float(s) for s in snr],
+                          "rq": 0.85, "cx": 3}))
+    out_bam = str(tmp_path / "out.bam")
+    report = str(tmp_path / "report.csv")
+    rtc = {"resolved_tool_contract": {
+        "tool_contract_id": "pbccs.tasks.ccs",
+        "input_files": [in_bam],
+        "output_files": [out_bam, report],
+        "nproc": 1,
+        "options": {"pbccs.task_options.min_passes": 2,
+                    "pbccs.task_options.min_length": 5},
+    }}
+    rtc_path = str(tmp_path / "rtc.json")
+    with open(rtc_path, "w") as fh:
+        json.dump(rtc, fh)
+    rc = contract.run_resolved_tool_contract(rtc_path)
+    assert rc == 0
+    assert os.path.exists(out_bam) and os.path.exists(report)
+    assert os.path.exists(out_bam + ".pbi")
+    recs = list(BamReader(out_bam))
+    assert len(recs) >= 1
+    idx = PbiIndex(out_bam + ".pbi")
+    assert idx.n_reads == len(recs)
+
+
+def _edna_params():
+    # move emission: strongly peaked on the template channel; obs 0 = dark
+    move = []
+    stay = []
+    for base in range(1, 5):
+        m = [0.02] * 5
+        m[base] = 0.9
+        m[0] = 0.04
+        move += m
+        s = [0.05] * 5
+        s[base] = 0.8
+        stay += s
+    return EdnaModelParams(p_stay=(0.1,) * 4, p_merge=(0.2,) * 4,
+                           move_dists=tuple(move), stay_dists=tuple(stay))
+
+
+def test_edna_scores_match_template():
+    p = _edna_params()
+    tpl = np.array([1, 2, 3, 4, 1], np.int32)
+    ev_match = EdnaEvaluator(tpl.copy(), tpl, p)
+    other = np.array([2, 1, 4, 3, 2], np.int32)
+    ev_other = EdnaEvaluator(other, tpl, p)
+    assert ev_match.loglik() > ev_other.loglik()
+    # merge requires equal adjacent template channels and matching obs
+    tpl2 = np.array([2, 2, 3], np.int32)
+    ev = EdnaEvaluator(np.array([2, 3], np.int32), tpl2, p)
+    assert np.isfinite(ev.merge(0, 0))
+    assert ev.merge(1, 0) == -np.inf
+    # score_move identities (EdnaEvaluator.hpp:239-262)
+    assert ev.score_move(0, 0, 2) == pytest.approx(
+        np.log(0.1 * p.stay_dist(2, 2)))
+    # the j1+2 move emits from template position j1+1 (base 2 here)
+    assert ev.score_move(0, 2, 2) == pytest.approx(
+        np.log((1 - 0.1) * 0.2 * p.move_dist(2, 2)))

@@ -1,0 +1,131 @@
+"""Quiver model family: banded recursor vs dense log-space oracle, config
+table semantics, and end-to-end polish round trip.
+
+Pattern: reference ConsensusCore TestRecursors.cpp typed tests (same scores
+from every implementation) + TestMultiReadMutationScorer round trips, using
+the deterministic TestingParams-scale parameter fixture
+(reference src/Tests/ParameterSettings.cpp:47-63)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.models.quiver import (
+    ALL_MOVES,
+    BASIC_MOVES,
+    QuiverConfig,
+    QuiverConfigTable,
+    QvModelParams,
+    QvSequenceFeatures,
+    QuiverMultiReadScorer,
+    quiver_backward,
+    quiver_forward,
+    quiver_loglik,
+    quiver_loglik_backward,
+)
+from pbccs_tpu.models.quiver.params import BandingOptions
+from pbccs_tpu.models.quiver.recursor import dense_loglik, feature_arrays
+
+
+def _random_features(rng, tpl, sub=0.05, dele=0.04, ins=0.05):
+    out = []
+    for b in tpl:
+        u = rng.random()
+        if u < sub:
+            out.append(int(rng.integers(0, 4)))
+        elif u < sub + dele:
+            continue
+        else:
+            out.append(int(b))
+            if rng.random() < ins:
+                out.append(int(rng.integers(0, 4)))
+    seq = np.array(out or [0], np.int8)
+    n = len(seq)
+    return QvSequenceFeatures(
+        seq,
+        rng.integers(5, 25, n).astype(np.float32),
+        rng.integers(5, 25, n).astype(np.float32),
+        rng.integers(5, 25, n).astype(np.float32),
+        rng.integers(0, 5, n).astype(np.float32),
+        rng.integers(5, 25, n).astype(np.float32))
+
+
+@pytest.mark.parametrize("moves", [BASIC_MOVES, ALL_MOVES])
+def test_banded_matches_dense_oracle(rng, moves):
+    cfg = QuiverConfig(moves_available=moves, banding=BandingOptions(band_width=48))
+    for trial in range(6):
+        J = int(rng.integers(8, 60))
+        tpl = rng.integers(0, 4, J).astype(np.int8)
+        feat = _random_features(rng, tpl)
+        ref = dense_loglik(feat, tpl, cfg.qv_params, use_merge=bool(moves & 8))
+        Imax = 128
+        Jmax = 64
+        fa = feature_arrays(feat, Imax)
+        wpad = np.full(Jmax, 4, np.int8)
+        wpad[:J] = tpl
+        alpha = quiver_forward(fa, jnp.int32(len(feat)), jnp.asarray(wpad),
+                               jnp.int32(J), cfg, 48)
+        beta = quiver_backward(fa, jnp.int32(len(feat)), jnp.asarray(wpad),
+                               jnp.int32(J), cfg, 48)
+        lla = float(quiver_loglik(alpha, len(feat), J))
+        llb = float(quiver_loglik_backward(beta, J))
+        assert abs(lla - ref) < 1e-2, (trial, lla, ref)
+        assert abs(llb - ref) < 1e-2, (trial, llb, ref)
+
+
+def test_merge_move_rewards_homopolymer_merge(rng):
+    # template with a long homopolymer; read drops one of the repeated bases
+    tpl = np.array([0, 1, 2, 2, 2, 2, 3, 0, 1, 3], np.int8)
+    read = np.array([0, 1, 2, 2, 2, 3, 0, 1, 3], np.int8)  # one 2 merged away
+    n = len(read)
+    feat = QvSequenceFeatures(read, *(np.zeros(n, np.float32) for _ in range(4)),
+                              np.zeros(n, np.float32))
+    basic = dense_loglik(feat, tpl, QvModelParams(), use_merge=False)
+    merged = dense_loglik(feat, tpl, QvModelParams(), use_merge=True)
+    assert merged > basic  # merge explains the missing homopolymer base
+
+
+def test_config_table_alias_and_fallback():
+    table = QuiverConfigTable()
+    c2 = QuiverConfig(qv_params=QvModelParams(chemistry="C2"))
+    assert table.insert(c2)
+    assert not table.insert(c2)                       # duplicate rejected
+    assert table.insert_as("XL-C2", c2)               # alias
+    assert table.at("XL-C2").qv_params.chemistry == "C2"
+    with pytest.raises(KeyError):
+        table.at("P6-C4")
+    table.insert_default(QuiverConfig(qv_params=QvModelParams(chemistry="default")))
+    assert table.at("P6-C4").qv_params.chemistry == "default"
+
+
+def test_scorer_recovers_corrupted_template(rng):
+    J = 60
+    tpl = rng.integers(0, 4, J).astype(np.int8)
+    feats = [_random_features(rng, tpl) for _ in range(6)]
+    corrupted = tpl.copy()
+    corrupted[J // 2] = (corrupted[J // 2] + 1) % 4
+    sc = QuiverMultiReadScorer(corrupted, feats, [0] * 6, [0] * 6, [J] * 6)
+    assert sc.active.sum() >= 4
+    muts = mutlib.enumerate_unique(sc.tpl)
+    scores = sc.score_mutations(muts)
+    best = max(zip(muts, scores), key=lambda t: t[1])
+    assert best[1] > 0
+    assert best[0].start == J // 2 and best[0].mtype == mutlib.SUBSTITUTION
+    assert best[0].new_base == tpl[J // 2]
+    base_before = sc.baseline_total()
+    sc.apply_mutations([best[0]])
+    assert sc.baseline_total() > base_before
+    assert np.array_equal(sc.tpl, tpl)
+
+
+def test_scorer_reverse_strand_reads(rng):
+    from pbccs_tpu.models.arrow.params import revcomp
+    J = 50
+    tpl = rng.integers(0, 4, J).astype(np.int8)
+    rc = revcomp(tpl)
+    feats = [_random_features(rng, tpl) for _ in range(3)] + \
+        [_random_features(rng, rc) for _ in range(3)]
+    sc = QuiverMultiReadScorer(tpl, feats, [0, 0, 0, 1, 1, 1],
+                               [0] * 6, [J] * 6)
+    assert sc.active.sum() >= 4
