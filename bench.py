@@ -18,7 +18,7 @@ so the baseline is measured on a faithful reimplementation.
 Usage:
   python bench.py                      # bench on the default jax platform
   python bench.py --record-cpu-baseline  # measure + store the CPU baseline
-Env knobs: BENCH_ZMWS (8), BENCH_TPL_LEN (300), BENCH_PASSES (8),
+Env knobs: BENCH_ZMWS (32), BENCH_TPL_LEN (300), BENCH_PASSES (8),
 BENCH_CORRUPTIONS (2).
 """
 
@@ -96,11 +96,15 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int):
 def main() -> None:
     record_baseline = "--record-cpu-baseline" in sys.argv
     if record_baseline:
+        # the ambient environment may import jax at interpreter startup with
+        # a TPU plugin and JAX_PLATFORMS already set; the env var alone is
+        # captured too late, so force the config before any backend is used
+        # (same workaround as tests/conftest.py)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        # the axon plugin hooks interpreter startup; too late to strip here,
-        # but forcing the platform keeps compute on host CPU
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
-    n_zmws = int(os.environ.get("BENCH_ZMWS", 8))
+    n_zmws = int(os.environ.get("BENCH_ZMWS", 32))
     tpl_len = int(os.environ.get("BENCH_TPL_LEN", 300))
     n_passes = int(os.environ.get("BENCH_PASSES", 8))
     n_corr = int(os.environ.get("BENCH_CORRUPTIONS", 2))

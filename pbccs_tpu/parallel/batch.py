@@ -274,20 +274,32 @@ class BatchPolisher:
         return tl, tlens
 
     def _setup(self, first: bool) -> None:
-        """(Re)build all window fills; gate reads on the first build."""
+        """(Re)build all window fills; gate reads on the first build.
+
+        Device copies of the loop-invariant read arrays are cached here:
+        re-uploading (Z, R, Imax) tensors on every scoring call costs a
+        host->device transfer per refinement round."""
         tl, tlens = self._template_arrays()
         self._tlens = tlens
+        if not hasattr(self, "_reads_dev"):
+            self._reads_dev = self._shard(self._reads, 1)
+            self._rlens_dev = self._shard(self._rlens, 1)
+            self._strands_dev = self._shard(self._strands, 1)
+        self._tstarts_dev = self._shard(self._tstarts, 1)
+        self._tends_dev = self._shard(self._tends, 1)
+        self._tlens_dev = self._shard(tlens)
+        self._baselines_dev = None  # set after fills below
         (self.win_tpl, self.win_trans, self.wlens, alpha, beta,
          ll_a, ll_b, self.a_prefix, self.b_suffix,
          self.trans_f, self.tpl_r, self.trans_r, self.table,
          mu, var) = _batch_setup(
-            self._shard(tl), self._shard(tlens),
+            self._shard(tl), self._tlens_dev,
             self._shard(self._host_tables),
-            self._shard(self._reads, read_axis=1),
-            self._shard(self._rlens, read_axis=1),
-            self._shard(self._strands, read_axis=1),
-            self._shard(self._tstarts, read_axis=1),
-            self._shard(self._tends, read_axis=1),
+            self._reads_dev,
+            self._rlens_dev,
+            self._strands_dev,
+            self._tstarts_dev,
+            self._tends_dev,
             self._W,
             # pallas_call has no SPMD partitioning rule: under a mesh GSPMD
             # would all-gather the flattened coefficient tensors and run the
@@ -296,10 +308,13 @@ class BatchPolisher:
             use_pallas=fills_use_pallas() and self.mesh is None)
         self.alpha, self.beta = alpha, beta
         self._tpl_dev = self._shard(tl)
+        self._tpl32_dev = self._tpl_dev.astype(jnp.int32)
+        self._tpl32_r_dev = self.tpl_r.astype(jnp.int32)
 
         ll_a = np.asarray(ll_a, np.float64)
         ll_b = np.asarray(ll_b, np.float64)
         self.baselines = ll_b
+        self._baselines_dev = self._shard(ll_b, 1)
         self._ll_mu = np.asarray(mu, np.float64)
         self._ll_var = np.asarray(var, np.float64)
         mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
@@ -334,12 +349,12 @@ class BatchPolisher:
         Ls = self._tlens.astype(np.int64)
 
         patches_f = _batch_patches(
-            self._tpl_dev.astype(jnp.int32), self.trans_f, self.table,
-            self._shard(self._tlens), self._shard(pos_f),
+            self._tpl32_dev, self.trans_f, self.table,
+            self._tlens_dev, self._shard(pos_f),
             self._shard(mtype), self._shard(base_f))
         patches_r = _batch_patches(
-            self.tpl_r.astype(jnp.int32), self.trans_r, self.table,
-            self._shard(self._tlens), self._shard(pos_r),
+            self._tpl32_r_dev, self.trans_r, self.table,
+            self._tlens_dev, self._shard(pos_r),
             self._shard(mtype), self._shard(base_r))
 
         # (Z, R, M) host-side classification
@@ -358,18 +373,31 @@ class BatchPolisher:
         edge_mask = act & overlap & ~interior
 
         totals = np.asarray(_batch_interior_totals(
-            self._shard(self._reads, 1), self._shard(self._rlens, 1),
-            self._shard(self._strands, 1), self._shard(self._tstarts, 1),
-            self._shard(self._tends, 1),
+            self._reads_dev, self._rlens_dev,
+            self._strands_dev, self._tstarts_dev,
+            self._tends_dev,
             self.win_tpl, self.win_trans, self.wlens,
             self.alpha.vals, self.alpha.offsets, self.alpha.log_scales,
             self.beta.vals, self.beta.offsets, self.beta.log_scales,
-            self.a_prefix, self.b_suffix, self._shard(self.baselines, 1),
+            self.a_prefix, self.b_suffix, self._baselines_dev,
             self._shard(pos_f), self._shard(end_f), self._shard(mtype),
             patches_f, patches_r, self._shard(int_mask, 1)), np.float64)
 
-        ez, er, em = np.nonzero(edge_mask)
-        if len(ez):
+        ez_all, er_all, em_all = np.nonzero(edge_mask)
+        if len(ez_all):
+            pf_b = np.asarray(patches_f.bases)
+            pf_t = np.asarray(patches_f.trans)
+            pf_s = np.asarray(patches_f.shift)
+            pr_b = np.asarray(patches_r.bases)
+            pr_t = np.asarray(patches_r.trans)
+            pr_s = np.asarray(patches_r.shift)
+        # chunk the edge pairs: one huge pallas fill batch can exceed the
+        # compiler's limits, and pow2 chunks keep the shape set bounded
+        EDGE_CHUNK = 1024
+        for lo in range(0, len(ez_all), EDGE_CHUNK):
+            ez = ez_all[lo: lo + EDGE_CHUNK]
+            er = er_all[lo: lo + EDGE_CHUNK]
+            em = em_all[lo: lo + EDGE_CHUNK]
             E = len(ez)
             Epad = next_pow2(E, 64)
             zi = np.zeros(Epad, np.int32)
@@ -382,18 +410,12 @@ class BatchPolisher:
             zi[:E], ri[:E] = ez, er
             pp[:E] = p_w[ez, er, em]
             pt[:E] = mtype[ez, em]
-            pf_b = np.asarray(patches_f.bases)
-            pf_t = np.asarray(patches_f.trans)
-            pf_s = np.asarray(patches_f.shift)
-            pr_b = np.asarray(patches_r.bases)
-            pr_t = np.asarray(patches_r.trans)
-            pr_s = np.asarray(patches_r.shift)
             fwd = self._strands[ez, er] == 0
             pb[:E] = np.where(fwd[:, None], pf_b[ez, em], pr_b[ez, em])
             ptr[:E] = np.where(fwd[:, None, None], pf_t[ez, em], pr_t[ez, em])
             psh[:E] = np.where(fwd, pf_s[ez, em], pr_s[ez, em])
             edge_ll = np.asarray(_batch_edge(
-                self._shard(self._reads, 1), self._shard(self._rlens, 1),
+                self._reads_dev, self._rlens_dev,
                 self.win_tpl, self.win_trans, self.wlens,
                 jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
                 jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),

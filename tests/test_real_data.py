@@ -1,0 +1,55 @@
+"""End-to-end CCS on the reference's real subread fixture.
+
+The reference uses tests/data/m140905_..._X0.fasta (10 real subread passes
+of one ZMW, ~600bp insert) to validate its POA stage
+(reference tests/TestSparsePoa.cpp:150-170, TestUtils.cpp:39-54); here the
+same real data drives the full filter -> draft -> polish -> QV pipeline
+through the ccs-compatible CLI, FASTA in / FASTA out."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+FIXTURE = ("/root/reference/tests/data/m140905_042212_sidney_"
+           "c100564852550000001823085912221377_s1_X0.fasta")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(FIXTURE),
+                                reason="reference fixture unavailable")
+
+
+def test_ccs_on_real_zmw(tmp_path):
+    from pbccs_tpu.cli import run
+    from pbccs_tpu.io.fasta import read_fasta
+
+    out = str(tmp_path / "out.fasta")
+    report = str(tmp_path / "report.csv")
+    rc = run([f"--reportFile={report}", "--skipChemistryCheck",
+              "--minPasses=3", out, FIXTURE])
+    assert rc == 0
+    recs = list(read_fasta(out))
+    assert len(recs) == 1
+    name, css = recs[0]
+    assert "6251" in name
+    # the insert is ~600bp (pass lengths 480-633 with adapters trimmed)
+    assert 500 <= len(css) <= 700
+
+    # every full pass should align to the consensus at subread identity
+    # or better (>=80% matches over the consensus span)
+    from pbccs_tpu.align.pairwise import AlignConfig, SEMIGLOBAL, align as nw_align
+    from pbccs_tpu.models.arrow.params import BASES, encode_bases, revcomp
+    cfg = AlignConfig(mode=SEMIGLOBAL)
+    idents = []
+    for rname, seq in read_fasta(FIXTURE):
+        if len(seq) < 400:      # partial last pass
+            continue
+        rc_seq = "".join(BASES[c] for c in revcomp(encode_bases(seq)))
+        best = 0.0
+        for cand in (seq, rc_seq):
+            aln = nw_align(cand, css, cfg)
+            best = max(best, aln.transcript.count("M") / max(len(css), 1))
+        idents.append(best)
+    assert len(idents) >= 9
+    assert np.mean(idents) > 0.80, idents
