@@ -21,7 +21,7 @@ Parity targets:
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Sequence
+from typing import Iterable, NamedTuple, Sequence
 
 import numpy as np
 
@@ -118,6 +118,108 @@ def unique_nearby_mutations(tpl: np.ndarray, centers: Iterable[Mutation],
                 seen.add(key)
                 out.append(cand)
     return out
+
+
+class MutationArrays(NamedTuple):
+    """A flat batch of single-base mutations as numpy arrays.
+
+    Same information as a list[Mutation], but amenable to vectorized
+    marshalling: the lockstep batch polisher enumerates ~9 candidates per
+    template position per round, and building Python objects for each was
+    measured as a dominant host cost (SURVEY.md section 3.4's mutation test
+    volume).  Field semantics match Mutation (start/end/mtype/new_base)."""
+
+    start: np.ndarray      # (M,) int32
+    end: np.ndarray        # (M,) int32
+    mtype: np.ndarray      # (M,) int32
+    new_base: np.ndarray   # (M,) int32 (-1 for deletions)
+
+    @property
+    def size(self) -> int:
+        return int(self.start.size)
+
+    def take(self, idx) -> "MutationArrays":
+        return MutationArrays(self.start[idx], self.end[idx],
+                              self.mtype[idx], self.new_base[idx])
+
+    def to_mutations(self, scores=None) -> list[Mutation]:
+        scores = np.zeros(self.size) if scores is None else scores
+        return [Mutation(int(s), int(e), int(t), int(b), float(sc))
+                for s, e, t, b, sc in zip(self.start, self.end, self.mtype,
+                                          self.new_base, scores)]
+
+
+def arrays_from_mutations(muts: Sequence[Mutation]) -> MutationArrays:
+    return MutationArrays(
+        np.fromiter((m.start for m in muts), np.int32, len(muts)),
+        np.fromiter((m.end for m in muts), np.int32, len(muts)),
+        np.fromiter((m.mtype for m in muts), np.int32, len(muts)),
+        np.fromiter((m.new_base for m in muts), np.int32, len(muts)))
+
+
+_SLOT_BASES = np.array([0, 1, 2, 3, 0, 1, 2, 3, -1], np.int32)
+_SLOT_TYPES = np.array([SUBSTITUTION] * 4 + [INSERTION] * 4 + [DELETION],
+                       np.int32)
+_SLOT_ENDOFF = np.array([1, 1, 1, 1, 0, 0, 0, 0, 1], np.int32)
+
+
+def enumerate_unique_arrays(tpl: np.ndarray, begin: int = 0,
+                            end: int | None = None) -> MutationArrays:
+    """Vectorized enumerate_unique: identical candidates in identical order
+    (per position: subs by base, then ins by base, then del), no per-candidate
+    Python objects."""
+    L = len(tpl)
+    end = L if end is None else min(end, L)
+    begin = max(begin, 0)
+    if end <= begin:
+        z = np.zeros(0, np.int32)
+        return MutationArrays(z, z, z, z)
+    t = np.asarray(tpl[begin:end], np.int32)
+    prev = np.empty_like(t)
+    prev[0] = tpl[begin - 1] if begin > 0 else -1
+    prev[1:] = t[:-1]
+    P = end - begin
+    pos = np.arange(begin, end, dtype=np.int32)
+
+    valid = np.empty((P, 9), bool)
+    valid[:, :4] = _SLOT_BASES[:4][None, :] != t[:, None]
+    valid[:, 4:8] = _SLOT_BASES[4:8][None, :] != prev[:, None]
+    valid[:, 8] = t != prev
+    f = valid.ravel()
+
+    starts = np.repeat(pos, 9)
+    ends = starts + np.tile(_SLOT_ENDOFF, P)
+    mtypes = np.tile(_SLOT_TYPES, P)
+    bases = np.tile(_SLOT_BASES, P)
+    return MutationArrays(starts[f], ends[f], mtypes[f], bases[f])
+
+
+def unique_nearby_arrays(tpl: np.ndarray, centers: Iterable[Mutation],
+                         neighborhood: int) -> MutationArrays:
+    """Vectorized unique_nearby_mutations: same candidates, same first-seen
+    order (dedup keeps the earliest occurrence across center windows)."""
+    parts = [enumerate_unique_arrays(tpl, m.start - neighborhood,
+                                     m.end + neighborhood) for m in centers]
+    if not parts:
+        z = np.zeros(0, np.int32)
+        return MutationArrays(z, z, z, z)
+    cat = MutationArrays(*(np.concatenate(x) for x in zip(*parts)))
+    # key uniquely identifies (start, end, mtype, new_base) for single-base
+    # mutations: (start, mtype, base) suffices (end is start + f(mtype))
+    key = (cat.start.astype(np.int64) * 16 + cat.mtype * 5
+           + (cat.new_base + 1))
+    _, first = np.unique(key, return_index=True)
+    first.sort()
+    return cat.take(first)
+
+
+def reverse_complement_arrays(arr: MutationArrays, tpl_len: int
+                              ) -> MutationArrays:
+    """Vectorized reverse_complement_mutation over a batch."""
+    comp = np.where(arr.new_base < 0, -1, 3 - arr.new_base).astype(np.int32)
+    return MutationArrays((tpl_len - arr.end).astype(np.int32),
+                          (tpl_len - arr.start).astype(np.int32),
+                          arr.mtype, comp)
 
 
 def apply_mutations(tpl: np.ndarray, muts: Sequence[Mutation]) -> np.ndarray:

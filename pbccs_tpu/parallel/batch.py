@@ -51,7 +51,6 @@ from pbccs_tpu.ops.fwdbwd import BandedMatrix
 from pbccs_tpu.ops.mutation_score import (
     INS,
     SUB,
-    MutationPatch,
     make_patches_fast,
 )
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
@@ -122,28 +121,29 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
 
 
 @jax.jit
-def _batch_patches(tpl32, trans, table, L, pos, mtype, base):
-    """(Z, M) virtual-mutation patches on one oriented template track."""
-
-    def one_zmw(t, tr, tb, l, p1, mt1, b1):
-        return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
-
-    return jax.vmap(one_zmw)(tpl32, trans, table, L, pos, mtype, base)
-
-
-@jax.jit
 def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
                            win_tpl, win_trans, wlens,
                            alpha_vals, alpha_offs, alpha_ls,
                            beta_vals, beta_offs, beta_ls,
                            a_prefix, b_suffix, baselines,
-                           mpos_f, mend_f, mtype,
-                           patches_f: MutationPatch, patches_r: MutationPatch,
+                           tpl32_f, trans_f, tpl32_r, trans_r, table, tlens,
+                           mpos_f, mend_f, mtype, mbase_f, mpos_r, mbase_r,
                            int_mask):
-    """(Z, M) = sum over reads of masked (LL(mut) - baseline).
+    """(Z, M) = sum over reads of masked (LL(mut) - baseline), plus the
+    fwd/rev virtual-mutation patches (built in the same program: a separate
+    patch dispatch per chunk costs two extra device round-trips per
+    refinement round).
 
     The read-axis reduction is the collective: with reads sharded over the
     'read' mesh axis XLA lowers the sum to an all-reduce over ICI."""
+
+    def one_patches(t, tr, tb, l, p1, mt1, b1):
+        return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
+
+    patches_f = jax.vmap(one_patches)(tpl32_f, trans_f, table, tlens,
+                                      mpos_f, mtype, mbase_f)
+    patches_r = jax.vmap(one_patches)(tpl32_r, trans_r, table, tlens,
+                                      mpos_r, mtype, mbase_r)
 
     def one_zmw(read1, rlen1, st1, ts1, te1, wt1, wtr1, wl1,
                 av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1,
@@ -161,13 +161,14 @@ def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
             av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1, mask1)
         return jnp.sum(per_read, axis=0)
 
-    return jax.vmap(one_zmw)(reads, rlens, strands, tstarts, tends,
-                             win_tpl, win_trans, wlens,
-                             alpha_vals, alpha_offs, alpha_ls,
-                             beta_vals, beta_offs, beta_ls,
-                             a_prefix, b_suffix, baselines,
-                             mpos_f, mend_f, mtype,
-                             patches_f, patches_r, int_mask)
+    totals = jax.vmap(one_zmw)(reads, rlens, strands, tstarts, tends,
+                               win_tpl, win_trans, wlens,
+                               alpha_vals, alpha_offs, alpha_ls,
+                               beta_vals, beta_offs, beta_ls,
+                               a_prefix, b_suffix, baselines,
+                               mpos_f, mend_f, mtype,
+                               patches_f, patches_r, int_mask)
+    return totals, patches_f, patches_r
 
 
 @functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
@@ -345,18 +346,6 @@ class BatchPolisher:
 
     def _score_chunk(self, pos_f, end_f, mtype, base_f, pos_r, base_r, valid):
         """Score one (Z, MUT_CHUNK) mutation slab; returns (Z, M) totals."""
-        Z, R = self._Z, self._R
-        Ls = self._tlens.astype(np.int64)
-
-        patches_f = _batch_patches(
-            self._tpl32_dev, self.trans_f, self.table,
-            self._tlens_dev, self._shard(pos_f),
-            self._shard(mtype), self._shard(base_f))
-        patches_r = _batch_patches(
-            self._tpl32_r_dev, self.trans_r, self.table,
-            self._tlens_dev, self._shard(pos_r),
-            self._shard(mtype), self._shard(base_r))
-
         # (Z, R, M) host-side classification
         ts = self._tstarts[:, :, None]
         te = self._tends[:, :, None]
@@ -372,7 +361,7 @@ class BatchPolisher:
         int_mask = act & overlap & interior
         edge_mask = act & overlap & ~interior
 
-        totals = np.asarray(_batch_interior_totals(
+        totals, patches_f, patches_r = _batch_interior_totals(
             self._reads_dev, self._rlens_dev,
             self._strands_dev, self._tstarts_dev,
             self._tends_dev,
@@ -380,8 +369,12 @@ class BatchPolisher:
             self.alpha.vals, self.alpha.offsets, self.alpha.log_scales,
             self.beta.vals, self.beta.offsets, self.beta.log_scales,
             self.a_prefix, self.b_suffix, self._baselines_dev,
+            self._tpl32_dev, self.trans_f, self._tpl32_r_dev, self.trans_r,
+            self.table, self._tlens_dev,
             self._shard(pos_f), self._shard(end_f), self._shard(mtype),
-            patches_f, patches_r, self._shard(int_mask, 1)), np.float64)
+            self._shard(base_f), self._shard(pos_r), self._shard(base_r),
+            self._shard(int_mask, 1))
+        totals = np.asarray(totals, np.float64)
 
         ez_all, er_all, em_all = np.nonzero(edge_mask)
         if len(ez_all):
@@ -425,17 +418,20 @@ class BatchPolisher:
 
         return totals
 
-    def score_mutations(self, muts_per_zmw: Sequence[Sequence[mutlib.Mutation]]
-                        ) -> list[np.ndarray]:
-        """Per-ZMW arrays of summed mutation scores (parity with
+    def score_mutation_arrays(self, arrs: Sequence[mutlib.MutationArrays]
+                              ) -> list[np.ndarray]:
+        """Per-ZMW arrays of summed mutation scores from MutationArrays
+        batches — the vectorized-marshalling fast path (parity with
         ArrowMultiReadScorer.score_mutations, batched over Z)."""
-        assert len(muts_per_zmw) == self.n_zmws
+        assert len(arrs) == self.n_zmws
         Z = self._Z
-        Mmax = max((len(m) for m in muts_per_zmw), default=0)
+        Mmax = max((a.size for a in arrs), default=0)
         if Mmax == 0:
-            return [np.zeros(0) for _ in muts_per_zmw]
+            return [np.zeros(0) for _ in arrs]
+        rcs = [mutlib.reverse_complement_arrays(a, len(self.tpls[z]))
+               for z, a in enumerate(arrs)]
         n_chunks = (Mmax + MUT_CHUNK - 1) // MUT_CHUNK
-        out = [np.zeros(len(m)) for m in muts_per_zmw]
+        out = [np.zeros(a.size) for a in arrs]
 
         for c in range(n_chunks):
             lo = c * MUT_CHUNK
@@ -451,22 +447,30 @@ class BatchPolisher:
                 L = len(self.tpls[z])
                 pos_f[z], end_f[z] = L // 2, L // 2 + 1
                 pos_r[z] = L - (L // 2) - 1
-                muts = muts_per_zmw[z][lo: lo + MUT_CHUNK]
-                for k, m in enumerate(muts):
-                    rcm = mutlib.reverse_complement_mutation(m, L)
-                    pos_f[z, k], end_f[z, k] = m.start, m.end
-                    mtype[z, k] = m.mtype
-                    base_f[z, k] = m.new_base
-                    pos_r[z, k] = rcm.start
-                    base_r[z, k] = rcm.new_base
-                    valid[z, k] = True
+                a, rc = arrs[z], rcs[z]
+                n = min(max(a.size - lo, 0), MUT_CHUNK)
+                if n:
+                    sl = slice(lo, lo + n)
+                    pos_f[z, :n] = a.start[sl]
+                    end_f[z, :n] = a.end[sl]
+                    mtype[z, :n] = a.mtype[sl]
+                    base_f[z, :n] = a.new_base[sl]
+                    pos_r[z, :n] = rc.start[sl]
+                    base_r[z, :n] = rc.new_base[sl]
+                    valid[z, :n] = True
             totals = self._score_chunk(pos_f, end_f, mtype, base_f,
                                        pos_r, base_r, valid)
             for z in range(self.n_zmws):
-                n = min(len(muts_per_zmw[z]) - lo, MUT_CHUNK)
+                n = min(max(arrs[z].size - lo, 0), MUT_CHUNK)
                 if n > 0:
                     out[z][lo: lo + n] = totals[z, :n]
         return out
+
+    def score_mutations(self, muts_per_zmw: Sequence[Sequence[mutlib.Mutation]]
+                        ) -> list[np.ndarray]:
+        """Object-list convenience wrapper over score_mutation_arrays."""
+        return self.score_mutation_arrays(
+            [mutlib.arrays_from_mutations(m) for m in muts_per_zmw])
 
     # --------------------------------------------------------------- mutation
 
@@ -501,19 +505,20 @@ class BatchPolisher:
         favorable: list[list[mutlib.Mutation]] = [[] for _ in range(Z)]
         done = np.zeros(Z, bool)
 
+        empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         for it in range(opts.max_iterations):
-            muts_per_zmw: list[list[mutlib.Mutation]] = []
+            arrs: list[mutlib.MutationArrays] = []
             for z in range(Z):
                 if done[z]:
-                    muts_per_zmw.append([])
+                    arrs.append(empty)
                 elif it == 0:
-                    muts_per_zmw.append(mutlib.enumerate_unique(self.tpls[z]))
+                    arrs.append(mutlib.enumerate_unique_arrays(self.tpls[z]))
                 else:
-                    muts_per_zmw.append(mutlib.unique_nearby_mutations(
+                    arrs.append(mutlib.unique_nearby_arrays(
                         self.tpls[z], favorable[z], opts.mutation_neighborhood))
             if all(done):
                 break
-            scores = self.score_mutations(muts_per_zmw)
+            scores = self.score_mutation_arrays(arrs)
 
             best_per_zmw: list[list[mutlib.Mutation]] = []
             for z in range(Z):
@@ -521,9 +526,9 @@ class BatchPolisher:
                     best_per_zmw.append([])
                     continue
                 results[z].iterations = it + 1
-                results[z].n_tested += len(muts_per_zmw[z])
-                fav = [m.with_score(s)
-                       for m, s in zip(muts_per_zmw[z], scores[z]) if s > 0.0]
+                results[z].n_tested += arrs[z].size
+                favi = np.nonzero(scores[z] > 0.0)[0]
+                fav = arrs[z].take(favi).to_mutations(scores[z][favi])
                 favorable[z] = fav
                 if not fav:
                     results[z].converged = True
@@ -554,14 +559,14 @@ class BatchPolisher:
     def consensus_qvs(self) -> list[np.ndarray]:
         """Per-ZMW per-position QVs (parity: ConsensusQVs,
         Consensus-inl.hpp:277-297), one batched sweep."""
-        muts_per_zmw = [mutlib.enumerate_unique(t) for t in self.tpls[: self.n_zmws]]
-        scores = self.score_mutations(muts_per_zmw)
+        arrs = [mutlib.enumerate_unique_arrays(t)
+                for t in self.tpls[: self.n_zmws]]
+        scores = self.score_mutation_arrays(arrs)
         out = []
         for z in range(self.n_zmws):
             ssum = np.zeros(len(self.tpls[z]))
-            for m, s in zip(muts_per_zmw[z], scores[z]):
-                if s < 0.0:
-                    ssum[m.start] += np.exp(s)
+            neg = scores[z] < 0.0
+            np.add.at(ssum, arrs[z].start[neg], np.exp(scores[z][neg]))
             prob = 1.0 - 1.0 / (1.0 + ssum)
             prob = np.maximum(prob, np.finfo(float).tiny)
             out.append(np.round(-10.0 * np.log10(prob)).astype(np.int32))
