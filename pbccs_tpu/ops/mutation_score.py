@@ -23,6 +23,7 @@ index-shifts everything beyond p.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -306,16 +307,19 @@ def _row_select(idx, src):
                        preferred_element_type=jnp.float32,
                        precision=jax.lax.Precision.HIGHEST)
 
-_NB = 7      # virtual-template neighborhood: positions p-3 .. p+3
+# virtual-template neighborhood half-widths: the interior scorer looks up
+# virtual positions p-3..p+3; the edge scorer's refill-from-begin needs p-4..p+4
+_NB_INTERIOR = 7
+_NB_EDGE = 11
 
 
-def _neighborhoods(win_tpl_f32, win_trans):
-    """Per-column neighborhood matrices: nb_tpl[j, c] = win_tpl[clip(j+c-3)],
-    nb_trans[j, c, :] = win_trans[clip(j+c-3)]; static shifts only."""
+def _neighborhoods(win_tpl_f32, win_trans, nb: int):
+    """Per-column neighborhood matrices: nb_tpl[j, c] = win_tpl[clip(j+c-nb//2)],
+    nb_trans[j, c, :] = win_trans[clip(j+c-nb//2)]; static shifts only."""
     Jm = win_tpl_f32.shape[0]
     cols_t, cols_r = [], []
-    for c in range(_NB):
-        t = c - 3
+    for c in range(nb):
+        t = c - nb // 2
         idx_lo, idx_hi = max(0, -t), Jm - max(0, t)
         head = max(0, -t)
         tail = max(0, t)
@@ -332,6 +336,80 @@ def _neighborhoods(win_tpl_f32, win_trans):
         cols_t.append(tpl_sh)
         cols_r.append(tr_sh)
     return jnp.stack(cols_t, axis=1), jnp.stack(cols_r, axis=1)
+
+
+def _virtual_lookup(win_tpl, win_trans, p, patch_bases, patch_trans,
+                    patch_shift, nb: int):
+    """Build the (vb, vt) virtual-template lookup closures shared by the
+    interior and edge scorers: vb(c)/vt(c) return the base / transition row
+    at virtual window index p + c (c in [-(nb//2)+1, nb//2-1]), with the
+    mutation's patched values at p-1 and p and the index shift beyond p
+    (TemplateParameterPair::GetTemplatePosition semantics)."""
+    nbh = nb // 2
+    nb_tpl, nb_trans = _neighborhoods(win_tpl.astype(jnp.float32),
+                                      win_trans, nb)
+    sel_p = _row_select(p, jnp.concatenate(
+        [nb_tpl, nb_trans.reshape(nb_tpl.shape[0], nb * 4)], axis=1))
+    nbt = sel_p[:, :nb]
+    nbr = sel_p[:, nb:].reshape(-1, nb, 4)
+    pb0 = patch_bases[:, 0].astype(jnp.float32)
+    pb1 = patch_bases[:, 1].astype(jnp.float32)
+
+    def vb(c):
+        c = jnp.broadcast_to(jnp.asarray(c, jnp.int32), p.shape)
+        col = jnp.clip(c + nbh + jnp.where(c > 0, patch_shift, 0), 0, nb - 1)
+        raw = jnp.sum(jnp.where(col[:, None] == jnp.arange(nb), nbt, 0.0),
+                      axis=1)
+        return jnp.where(c == -1, pb0, jnp.where(c == 0, pb1, raw))
+
+    def vt(c):
+        c = jnp.broadcast_to(jnp.asarray(c, jnp.int32), p.shape)
+        col = jnp.clip(c + nbh + jnp.where(c > 0, patch_shift, 0), 0, nb - 1)
+        raw = jnp.sum(jnp.where((col[:, None] == jnp.arange(nb))[:, :, None],
+                                nbr, 0.0), axis=1)
+        raw = jnp.where((c == -1)[:, None], patch_trans[:, 0], raw)
+        return jnp.where((c == 0)[:, None], patch_trans[:, 1], raw)
+
+    return vb, vt
+
+
+def _ext_col(prev_vals, d, o_col, rbase_row, jcol, cur_b, next_b,
+             prev_tr, cur_tr, *, I, max_left, hit, em_miss, W):
+    """One batched virtual-template DP column (the ExtendAlpha column fill of
+    the gather-free scorers): solves the within-column insertion recurrence
+    over the band for every mutation row at virtual DP column `jcol`.
+
+    prev_vals: (M, W) previous virtual column; d: (M,) band-offset delta
+    o_col - o_prev; o_col: (M,) band offset of this column; rbase_row /
+    cur_b / next_b / prev_tr / cur_tr: per-mutation read/template context.
+    Handles the j == 1 start column (reachable only by the pinned initial
+    match, reference SimpleRecursor.cpp:119-141) and the pinned (I, J)
+    corner."""
+    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
+    rows = o_col[:, None] + karange
+    in_read = (rows >= 1) & (rows <= I)
+    em = jnp.where(rbase_row == cur_b[:, None], hit, em_miss)
+    pm1 = _select_shift(prev_vals, d - 1, -1, 7)
+    p0 = _select_shift(prev_vals, d, 0, 7)
+
+    generic = (rows < I) & (jcol < max_left)[:, None]
+    pinned = (rows == I) & (jcol == max_left)[:, None]
+    mfac = jnp.where(generic, prev_tr[:, TRANS_MATCH][:, None],
+                     jnp.where(pinned, 1.0, 0.0))
+    mfac = jnp.where((jcol == 1)[:, None],
+                     jnp.where(rows == 1, 1.0, 0.0), mfac)
+    b = pm1 * em * mfac
+    b = b + jnp.where(((jcol > 1) & (jcol < max_left))[:, None]
+                      & (rows != I),
+                      p0 * prev_tr[:, TRANS_DARK][:, None], 0.0)
+    b = jnp.where(in_read, b, 0.0)
+
+    ins_em = jnp.where(rbase_row == next_b[:, None],
+                       cur_tr[:, TRANS_BRANCH][:, None],
+                       cur_tr[:, TRANS_STICK][:, None] / 3.0)
+    c = jnp.where(in_read & (rows > 1) & (rows < I)
+                  & (jcol != max_left)[:, None], ins_em, 0.0)
+    return _affine_scan(b, c)
 
 
 def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
@@ -388,56 +466,11 @@ def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
     sel_b = _row_select(blc, jnp.concatenate([beta.vals, boffs_f, bsuf_col], axis=1))
     B_col, o_b, bsuf_b = sel_b[:, :W], sel_b[:, W].astype(jnp.int32), sel_b[:, W + 1]
 
-    nb_tpl, nb_trans = _neighborhoods(win_tpl.astype(jnp.float32), win_trans)
-    sel_p = _row_select(p, jnp.concatenate(
-        [nb_tpl, nb_trans.reshape(nb_tpl.shape[0], _NB * 4)], axis=1))
-    nbt = sel_p[:, :_NB]                                      # (M, 7)
-    nbr = sel_p[:, _NB:].reshape(-1, _NB, 4)                  # (M, 7, 4)
-
-    # ---- virtual base / transition lookups around p --------------------
-    pb0, pb1 = patch_bases[:, 0].astype(jnp.float32), patch_bases[:, 1].astype(jnp.float32)
-
-    def vb(c):
-        """virtual base at window index p + c; c: (M,) in [-3, 2]."""
-        col = jnp.clip(c + 3 + jnp.where(c > 0, patch_shift, 0), 0, _NB - 1)
-        raw = jnp.sum(jnp.where(col[:, None] == jnp.arange(_NB), nbt, 0.0), axis=1)
-        return jnp.where(c == -1, pb0, jnp.where(c == 0, pb1, raw))
-
-    def vt(c):
-        """virtual transition row at window index p + c -> (M, 4)."""
-        col = jnp.clip(c + 3 + jnp.where(c > 0, patch_shift, 0), 0, _NB - 1)
-        raw = jnp.sum(jnp.where((col[:, None] == jnp.arange(_NB))[:, :, None],
-                                nbr, 0.0), axis=1)
-        raw = jnp.where((c == -1)[:, None], patch_trans[:, 0], raw)
-        return jnp.where((c == 0)[:, None], patch_trans[:, 1], raw)
-
+    vb, vt = _virtual_lookup(win_tpl, win_trans, p, patch_bases, patch_trans,
+                             patch_shift, _NB_INTERIOR)
+    one_col = functools.partial(_ext_col, I=I, max_left=max_left,
+                                hit=hit, em_miss=em_miss, W=W)
     karange = jnp.arange(W, dtype=jnp.int32)[None, :]
-
-    # explicit two-column extension (j = s, then j = s + 1)
-    def one_col(prev_vals, d, o_col, rbase_row, jcol, cur_b, next_b,
-                prev_tr, cur_tr):
-        rows = o_col[:, None] + karange
-        in_read = (rows >= 1) & (rows <= I)
-        em = jnp.where(rbase_row == cur_b[:, None], hit, em_miss)
-        pm1 = _select_shift(prev_vals, d - 1, -1, 7)
-        p0 = _select_shift(prev_vals, d, 0, 7)
-
-        generic = (rows < I) & (jcol < max_left)[:, None]
-        pinned = (rows == I) & (jcol == max_left)[:, None]
-        mfac = jnp.where(generic, prev_tr[:, TRANS_MATCH][:, None],
-                         jnp.where(pinned, 1.0, 0.0))
-        b = pm1 * em * mfac
-        b = b + jnp.where(((jcol > 1) & (jcol < max_left))[:, None]
-                          & (rows != I),
-                          p0 * prev_tr[:, TRANS_DARK][:, None], 0.0)
-        b = jnp.where(in_read, b, 0.0)
-
-        ins_em = jnp.where(rbase_row == next_b[:, None],
-                           cur_tr[:, TRANS_BRANCH][:, None],
-                           cur_tr[:, TRANS_STICK][:, None] / 3.0)
-        c = jnp.where(in_read & (rows > 1) & (rows < I)
-                      & (jcol != max_left)[:, None], ins_em, 0.0)
-        return _affine_scan(b, c)
 
     c_sm1 = s - 1 - p
     c_s = s - p
@@ -479,6 +512,134 @@ def interior_read_scores_fast(read, rlen, strand, ts, te, win_tpl, win_trans,
                                 win_tpl.astype(jnp.int32), win_trans, wl,
                                 alpha, beta, apre, bsuf,
                                 p, mtype, pb, pt, ps)
+
+
+def edge_scores_fast(read, read_len, win_tpl, win_trans, win_len,
+                     alpha: BandedMatrix, beta: BandedMatrix,
+                     alpha_prefix, beta_suffix,
+                     p, mtype, patch_bases, patch_trans, patch_shift,
+                     pr_miscall: float = MISMATCH_PROBABILITY):
+    """(M,) absolute mutated-template log-likelihoods of one read for
+    mutations near a window boundary — the gather-free batched form of the
+    reference's extend-from-begin / extend-to-end specializations
+    (MutationScorer.cpp:208-231), which the full-refill fallback previously
+    served at O(window) cost per pair.
+
+    near-begin (p <= 2):  refill virtual DP columns 1..4 from the pinned
+        start column, then LinkAlphaBeta at virtual column 5 (old-frame
+        column 5 - ld) against the saved beta.
+    near-end (p >= 3, caller guarantees the mutation end is within 1 of the
+        window end):  extend saved alpha columns s..s+2 through the pinned
+        (I, J') corner; LL = log corner + alpha scale prefix.
+
+    Caller guarantees win_len >= 8, so the two regimes cannot overlap; tiny
+    windows stay on the full-refill path.
+    """
+    W = alpha.width
+    nc = alpha.vals.shape[0]
+    eps = pr_miscall
+    hit, em_miss = 1.0 - eps, eps / 3.0
+
+    I = jnp.asarray(read_len, jnp.int32)
+    J = jnp.asarray(win_len, jnp.int32)
+    ld = jnp.where(mtype == INS, 1, jnp.where(mtype == DEL, -1, 0))
+    s = jnp.where(mtype == DEL, p - 1, p)
+    max_left = J + ld
+    is_nb = p <= 2
+
+    read_f = read.astype(jnp.float32)
+    offs = alpha.offsets
+    rnext_win = window_rows(read_f, offs, W)                 # read[o_j + k]
+    rbase_win = window_rows(
+        jnp.concatenate([read_f[0:1], read_f]), offs, W)     # read[o_j + k - 1]
+
+    vb, vt = _virtual_lookup(win_tpl, win_trans, p, patch_bases, patch_trans,
+                             patch_shift, _NB_EDGE)
+    one_col = functools.partial(_ext_col, I=I, max_left=max_left,
+                                hit=hit, em_miss=em_miss, W=W)
+    M = p.shape[0]
+    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
+
+    # ---------------------------------------------------- near-begin branch
+    seed = jnp.zeros((M, W), jnp.float32).at[:, 0].set(1.0)   # alpha(0, 0)=1
+    ext = seed
+    o_prev = jnp.zeros((), jnp.int32)
+    for j in range(1, 5):
+        o_j = offs[j]
+        ext = one_col(ext, jnp.broadcast_to(o_j - o_prev, (M,)),
+                      jnp.broadcast_to(o_j, (M,)),
+                      jnp.broadcast_to(rbase_win[j], (M, W)),
+                      jnp.full((M,), j, jnp.int32),
+                      vb(j - 1 - p), vb(j - p), vt(j - 2 - p), vt(j - 1 - p))
+        o_prev = o_j
+
+    blc_nb = 5 - ld                                          # old-frame col
+    boffs_f = beta.offsets.astype(jnp.float32)[:, None]
+    bsuf_col = beta_suffix[:nc][:, None]
+    sel_b = _row_select(blc_nb, jnp.concatenate(
+        [beta.vals, boffs_f, bsuf_col], axis=1))
+    B_col, o_b = sel_b[:, :W], sel_b[:, W].astype(jnp.int32)
+    bsuf_b = sel_b[:, W + 1]
+
+    rows4 = offs[4] + karange
+    link_tr = vt(3 - p)
+    link_b = vb(4 - p)
+    em_link = jnp.where(jnp.broadcast_to(rnext_win[4], (M, W)) == link_b[:, None],
+                        hit, em_miss)
+    d_b = jnp.broadcast_to(offs[4], (M,)) - o_b
+    beta_ip1 = _select_shift(B_col, d_b + 1, -21, 1)
+    beta_i = _select_shift(B_col, d_b, -22, 0)
+    match_term = jnp.where(rows4 < I, ext * link_tr[:, TRANS_MATCH][:, None]
+                           * em_link * beta_ip1, 0.0)
+    del_term = ext * link_tr[:, TRANS_DARK][:, None] * beta_i
+    v_nb = jnp.sum(match_term + del_term, axis=1)
+    score_nb = jnp.log(jnp.maximum(v_nb, _TINY)) + bsuf_b
+
+    # ------------------------------------------------------ near-end branch
+    offs_f = offs.astype(jnp.float32)[:, None]
+    sel_sm1 = _row_select(s - 1, jnp.concatenate([alpha.vals, offs_f], axis=1))
+    A_prev, o_sm1 = sel_sm1[:, :W], sel_sm1[:, W].astype(jnp.int32)
+    apre_col = alpha_prefix[:nc][:, None]
+    sel_s = _row_select(s, jnp.concatenate([rbase_win, offs_f, apre_col], axis=1))
+    rb_s, o_s, apre_s = sel_s[:, :W], sel_s[:, W].astype(jnp.int32), sel_s[:, W + 1]
+    sel_s1 = _row_select(s + 1, jnp.concatenate([rbase_win, offs_f], axis=1))
+    rb_s1, o_s1 = sel_s1[:, :W], sel_s1[:, W].astype(jnp.int32)
+    sel_s2 = _row_select(s + 2, jnp.concatenate([rbase_win, offs_f], axis=1))
+    rb_s2, o_s2 = sel_s2[:, :W], sel_s2[:, W].astype(jnp.int32)
+
+    c0 = s - p
+    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s,
+                   vb(c0 - 1), vb(c0), vt(c0 - 2), vt(c0 - 1))
+    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s + 1,
+                   vb(c0), vb(c0 + 1), vt(c0 - 1), vt(c0))
+    ext2 = one_col(ext1, o_s2 - o_s1, o_s2, rb_s2, s + 2,
+                   vb(c0 + 1), vb(c0 + 2), vt(c0), vt(c0 + 1))
+
+    kstar = max_left - s                                     # 1 or 2
+    corner_vals = jnp.where((kstar == 1)[:, None], ext1, ext2)
+    o_corner = jnp.where(kstar == 1, o_s1, o_s2)
+    corner = jnp.sum(jnp.where(karange == (I - o_corner)[:, None],
+                               corner_vals, 0.0), axis=1)
+    score_ne = jnp.log(jnp.maximum(corner, _TINY)) + apre_s
+
+    return jnp.where(is_nb, score_nb, score_ne)
+
+
+def edge_read_scores_fast(read, rlen, strand, ts, te, win_tpl, win_trans,
+                          wl, alpha: BandedMatrix, beta: BandedMatrix,
+                          apre, bsuf, mpos_f, mend_f, mtype,
+                          patches_f: MutationPatch, patches_r: MutationPatch):
+    """(M,) edge-mutation LLs of one read: orient forward-frame mutations
+    into the read's window frame, then run the batched edge scorer."""
+    p = jnp.where(strand == 0, mpos_f - ts, te - mend_f)
+    fwd = strand == 0
+    pb = jnp.where(fwd, patches_f.bases, patches_r.bases)
+    pt = jnp.where(fwd, patches_f.trans, patches_r.trans)
+    ps = jnp.where(fwd, patches_f.shift, patches_r.shift)
+    return edge_scores_fast(read.astype(jnp.int32), rlen,
+                            win_tpl.astype(jnp.int32), win_trans, wl,
+                            alpha, beta, apre, bsuf,
+                            p, mtype, pb, pt, ps)
 
 
 def _shift_rows(x, t: int):

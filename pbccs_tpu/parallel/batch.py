@@ -51,6 +51,7 @@ from pbccs_tpu.ops.fwdbwd import BandedMatrix
 from pbccs_tpu.ops.mutation_score import (
     INS,
     SUB,
+    edge_read_scores_fast,
     make_patches_fast,
 )
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
@@ -59,6 +60,12 @@ from pbccs_tpu.utils import next_pow2
 # mutation-axis chunk: every scoring call uses this static M so one compiled
 # program serves every refinement round and the QV sweep
 MUT_CHUNK = 512
+# edge-mutation slab width: boundary mutations are O(reads), not O(template),
+# so their batched program uses a small static mutation axis
+EDGE_SLAB = 64
+# windows shorter than this score boundary mutations by full refill: the
+# extend-from-begin and extend-to-end regimes would overlap
+MIN_FAST_EDGE_WLEN = 8
 
 
 @dataclasses.dataclass
@@ -169,6 +176,53 @@ def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
                                mpos_f, mend_f, mtype,
                                patches_f, patches_r, int_mask)
     return totals, patches_f, patches_r
+
+
+@jax.jit
+def _batch_edge_fast_totals(reads, rlens, strands, tstarts, tends,
+                            win_tpl, win_trans, wlens,
+                            alpha_vals, alpha_offs, alpha_ls,
+                            beta_vals, beta_offs, beta_ls,
+                            a_prefix, b_suffix, baselines,
+                            tpl32_f, trans_f, tpl32_r, trans_r, table, tlens,
+                            mpos_f, mend_f, mtype, mbase_f, mpos_r, mbase_r,
+                            edge_mask):
+    """(Z, ME) = sum over reads of masked (LL(mut) - baseline) for
+    near-window-boundary mutations via the short extension programs
+    (ops.mutation_score.edge_scores_fast); same layout/collective shape as
+    _batch_interior_totals."""
+
+    def one_patches(t, tr, tb, l, p1, mt1, b1):
+        return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
+
+    patches_f = jax.vmap(one_patches)(tpl32_f, trans_f, table, tlens,
+                                      mpos_f, mtype, mbase_f)
+    patches_r = jax.vmap(one_patches)(tpl32_r, trans_r, table, tlens,
+                                      mpos_r, mtype, mbase_r)
+
+    def one_zmw(read1, rlen1, st1, ts1, te1, wt1, wtr1, wl1,
+                av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1,
+                mp1, me1, mt1, pf1, pr1, mask1):
+        def one_read(read, rlen, strand, ts, te, wt, wtr, wl,
+                     av, ao, als, bv, bo, bls, apre, bsuf, bl, mask):
+            lls = edge_read_scores_fast(
+                read, rlen, strand, ts, te, wt, wtr, wl,
+                BandedMatrix(av, ao, als), BandedMatrix(bv, bo, bls),
+                apre, bsuf, mp1, me1, mt1, pf1, pr1)
+            return jnp.where(mask, lls - bl, 0.0)
+
+        per_read = jax.vmap(one_read)(
+            read1, rlen1, st1, ts1, te1, wt1, wtr1, wl1,
+            av1, ao1, als1, bv1, bo1, bls1, apre1, bsuf1, base1, mask1)
+        return jnp.sum(per_read, axis=0)
+
+    return jax.vmap(one_zmw)(reads, rlens, strands, tstarts, tends,
+                             win_tpl, win_trans, wlens,
+                             alpha_vals, alpha_offs, alpha_ls,
+                             beta_vals, beta_offs, beta_ls,
+                             a_prefix, b_suffix, baselines,
+                             mpos_f, mend_f, mtype,
+                             patches_f, patches_r, edge_mask)
 
 
 @functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
@@ -344,8 +398,13 @@ class BatchPolisher:
 
     # ---------------------------------------------------------------- scoring
 
-    def _score_chunk(self, pos_f, end_f, mtype, base_f, pos_r, base_r, valid):
-        """Score one (Z, MUT_CHUNK) mutation slab; returns (Z, M) totals."""
+    def _dispatch_chunk(self, pos_f, end_f, mtype, base_f, pos_r, base_r,
+                        valid):
+        """Dispatch one (Z, MUT_CHUNK) slab's device programs without
+        blocking; pair with _collect_chunk.  Keeping several chunks in
+        flight hides dispatch latency behind device compute (the profile
+        showed ~2 host syncs per chunk serializing the refinement round)."""
+        Z = self._Z
         # (Z, R, M) host-side classification
         ts = self._tstarts[:, :, None]
         te = self._tends[:, :, None]
@@ -361,7 +420,7 @@ class BatchPolisher:
         int_mask = act & overlap & interior
         edge_mask = act & overlap & ~interior
 
-        totals, patches_f, patches_r = _batch_interior_totals(
+        totals_dev, patches_f, patches_r = _batch_interior_totals(
             self._reads_dev, self._rlens_dev,
             self._strands_dev, self._tstarts_dev,
             self._tends_dev,
@@ -374,48 +433,115 @@ class BatchPolisher:
             self._shard(pos_f), self._shard(end_f), self._shard(mtype),
             self._shard(base_f), self._shard(pos_r), self._shard(base_r),
             self._shard(int_mask, 1))
-        totals = np.asarray(totals, np.float64)
 
-        ez_all, er_all, em_all = np.nonzero(edge_mask)
-        if len(ez_all):
+        # boundary mutations on adequately long windows: short extension
+        # programs over (Z, R, EDGE_SLAB) slabs
+        fast_mask = edge_mask & (wlen >= MIN_FAST_EDGE_WLEN)
+        fb_mask = edge_mask & (wlen < MIN_FAST_EDGE_WLEN)
+        edge_jobs = []
+        em_any = fast_mask.any(axis=1)                      # (Z, M)
+        counts = em_any.sum(axis=1)
+        if counts.any():
+            idx_per_z = [np.nonzero(em_any[z])[0] for z in range(Z)]
+            n_slabs = (int(counts.max()) + EDGE_SLAB - 1) // EDGE_SLAB
+            for k in range(n_slabs):
+                spos_f = np.zeros((Z, EDGE_SLAB), np.int32)
+                send_f = np.ones((Z, EDGE_SLAB), np.int32)
+                smtype = np.full((Z, EDGE_SLAB), SUB, np.int32)
+                sbase_f = np.zeros((Z, EDGE_SLAB), np.int32)
+                spos_r = np.zeros((Z, EDGE_SLAB), np.int32)
+                sbase_r = np.zeros((Z, EDGE_SLAB), np.int32)
+                smask = np.zeros((Z, self._R, EDGE_SLAB), bool)
+                sel_idx = np.zeros((Z, EDGE_SLAB), np.int64)
+                used = np.zeros((Z, EDGE_SLAB), bool)
+                for z in range(self.n_zmws):
+                    L = len(self.tpls[z])
+                    spos_f[z], send_f[z] = L // 2, L // 2 + 1
+                    spos_r[z] = L - (L // 2) - 1
+                    mi = idx_per_z[z][k * EDGE_SLAB: (k + 1) * EDGE_SLAB]
+                    n = len(mi)
+                    if n:
+                        spos_f[z, :n] = pos_f[z, mi]
+                        send_f[z, :n] = end_f[z, mi]
+                        smtype[z, :n] = mtype[z, mi]
+                        sbase_f[z, :n] = base_f[z, mi]
+                        spos_r[z, :n] = pos_r[z, mi]
+                        sbase_r[z, :n] = base_r[z, mi]
+                        smask[z, :, :n] = fast_mask[z][:, mi]
+                        sel_idx[z, :n] = mi
+                        used[z, :n] = True
+                et_dev = _batch_edge_fast_totals(
+                    self._reads_dev, self._rlens_dev,
+                    self._strands_dev, self._tstarts_dev, self._tends_dev,
+                    self.win_tpl, self.win_trans, self.wlens,
+                    self.alpha.vals, self.alpha.offsets, self.alpha.log_scales,
+                    self.beta.vals, self.beta.offsets, self.beta.log_scales,
+                    self.a_prefix, self.b_suffix, self._baselines_dev,
+                    self._tpl32_dev, self.trans_f, self._tpl32_r_dev,
+                    self.trans_r, self.table, self._tlens_dev,
+                    self._shard(spos_f), self._shard(send_f),
+                    self._shard(smtype), self._shard(sbase_f),
+                    self._shard(spos_r), self._shard(sbase_r),
+                    self._shard(smask, 1))
+                zz, kk = np.nonzero(used)
+                edge_jobs.append((et_dev, zz, kk, sel_idx))
+
+        # tiny-window fallback pairs are resolved at collect time: their
+        # marshalling needs the patch values on host, and syncing here would
+        # serialize the dispatch pipeline (they are rare -- only windows
+        # shorter than MIN_FAST_EDGE_WLEN)
+        fb_state = None
+        if fb_mask.any():
+            fb_state = (np.nonzero(fb_mask), p_w, mtype,
+                        patches_f, patches_r)
+        return totals_dev, edge_jobs, fb_state
+
+    def _collect_chunk(self, state) -> np.ndarray:
+        """Block on one dispatched chunk's device results; (Z, M) totals."""
+        totals_dev, edge_jobs, fb_state = state
+        totals = np.asarray(totals_dev, np.float64)
+        for et_dev, zz, kk, sel_idx in edge_jobs:
+            et = np.asarray(et_dev, np.float64)
+            np.add.at(totals, (zz, sel_idx[zz, kk]), et[zz, kk])
+        if fb_state is not None:
+            (ez_all, er_all, em_all), p_w, mtype, patches_f, patches_r = fb_state
             pf_b = np.asarray(patches_f.bases)
             pf_t = np.asarray(patches_f.trans)
             pf_s = np.asarray(patches_f.shift)
             pr_b = np.asarray(patches_r.bases)
             pr_t = np.asarray(patches_r.trans)
             pr_s = np.asarray(patches_r.shift)
-        # chunk the edge pairs: one huge pallas fill batch can exceed the
-        # compiler's limits, and pow2 chunks keep the shape set bounded
-        EDGE_CHUNK = 1024
-        for lo in range(0, len(ez_all), EDGE_CHUNK):
-            ez = ez_all[lo: lo + EDGE_CHUNK]
-            er = er_all[lo: lo + EDGE_CHUNK]
-            em = em_all[lo: lo + EDGE_CHUNK]
-            E = len(ez)
-            Epad = next_pow2(E, 64)
-            zi = np.zeros(Epad, np.int32)
-            ri = np.zeros(Epad, np.int32)
-            pp = np.zeros(Epad, np.int32)
-            pt = np.zeros(Epad, np.int32)
-            pb = np.zeros((Epad, 2), np.int32)
-            ptr = np.zeros((Epad, 2, 4), np.float32)
-            psh = np.zeros(Epad, np.int32)
-            zi[:E], ri[:E] = ez, er
-            pp[:E] = p_w[ez, er, em]
-            pt[:E] = mtype[ez, em]
-            fwd = self._strands[ez, er] == 0
-            pb[:E] = np.where(fwd[:, None], pf_b[ez, em], pr_b[ez, em])
-            ptr[:E] = np.where(fwd[:, None, None], pf_t[ez, em], pr_t[ez, em])
-            psh[:E] = np.where(fwd, pf_s[ez, em], pr_s[ez, em])
-            edge_ll = np.asarray(_batch_edge(
-                self._reads_dev, self._rlens_dev,
-                self.win_tpl, self.win_trans, self.wlens,
-                jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
-                jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),
-                jnp.asarray(psh), self._W,
-                fills_use_pallas() and self.mesh is None), np.float64)[:E]
-            np.add.at(totals, (ez, em), edge_ll - self.baselines[ez, er])
-
+            # chunk the edge pairs: one huge pallas fill batch can exceed the
+            # compiler's limits, and pow2 chunks keep the shape set bounded
+            EDGE_CHUNK = 1024
+            for lo in range(0, len(ez_all), EDGE_CHUNK):
+                ez = ez_all[lo: lo + EDGE_CHUNK]
+                er = er_all[lo: lo + EDGE_CHUNK]
+                em = em_all[lo: lo + EDGE_CHUNK]
+                E = len(ez)
+                Epad = next_pow2(E, 64)
+                zi = np.zeros(Epad, np.int32)
+                ri = np.zeros(Epad, np.int32)
+                pp = np.zeros(Epad, np.int32)
+                pt = np.zeros(Epad, np.int32)
+                pb = np.zeros((Epad, 2), np.int32)
+                ptr = np.zeros((Epad, 2, 4), np.float32)
+                psh = np.zeros(Epad, np.int32)
+                zi[:E], ri[:E] = ez, er
+                pp[:E] = p_w[ez, er, em]
+                pt[:E] = mtype[ez, em]
+                fwd = self._strands[ez, er] == 0
+                pb[:E] = np.where(fwd[:, None], pf_b[ez, em], pr_b[ez, em])
+                ptr[:E] = np.where(fwd[:, None, None], pf_t[ez, em], pr_t[ez, em])
+                psh[:E] = np.where(fwd, pf_s[ez, em], pr_s[ez, em])
+                edge_ll = np.asarray(_batch_edge(
+                    self._reads_dev, self._rlens_dev,
+                    self.win_tpl, self.win_trans, self.wlens,
+                    jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
+                    jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),
+                    jnp.asarray(psh), self._W,
+                    fills_use_pallas() and self.mesh is None), np.float64)[:E]
+                np.add.at(totals, (ez, em), edge_ll - self.baselines[ez, er])
         return totals
 
     def score_mutation_arrays(self, arrs: Sequence[mutlib.MutationArrays]
@@ -433,6 +559,9 @@ class BatchPolisher:
         n_chunks = (Mmax + MUT_CHUNK - 1) // MUT_CHUNK
         out = [np.zeros(a.size) for a in arrs]
 
+        # dispatch every chunk before collecting any: the device works
+        # through the queued programs while the host marshals ahead
+        states = []
         for c in range(n_chunks):
             lo = c * MUT_CHUNK
             pos_f = np.zeros((Z, MUT_CHUNK), np.int32)
@@ -458,8 +587,12 @@ class BatchPolisher:
                     pos_r[z, :n] = rc.start[sl]
                     base_r[z, :n] = rc.new_base[sl]
                     valid[z, :n] = True
-            totals = self._score_chunk(pos_f, end_f, mtype, base_f,
-                                       pos_r, base_r, valid)
+            states.append(self._dispatch_chunk(pos_f, end_f, mtype, base_f,
+                                               pos_r, base_r, valid))
+
+        for c, state in enumerate(states):
+            lo = c * MUT_CHUNK
+            totals = self._collect_chunk(state)
             for z in range(self.n_zmws):
                 n = min(max(arrs[z].size - lo, 0), MUT_CHUNK)
                 if n > 0:

@@ -90,6 +90,62 @@ def test_interior_fast_matches_extend_link(zmw_state):
         assert diff.max() < 2e-3, (r, diff.max())
 
 
+def test_edge_fast_matches_full_refill(zmw_state):
+    """Boundary-mutation LLs from the short extension programs equal the
+    full banded refill of the mutated window, per read (the reference's
+    ExtendAlpha-to-end / ExtendBeta-to-begin vs full-refill equality fuzz,
+    TestRecursors.cpp:291-440)."""
+    sc, _, _, _ = zmw_state
+    L = len(sc.tpl)
+    # every mutation within 4 positions of either template end
+    cand = [m for m in mutlib.enumerate_unique(sc.tpl)
+            if m.start <= 4 or m.end >= L - 4]
+    pos_f, end_f, mtype, base_f, pos_r, base_r = sc._mutation_arrays(cand)
+    patches_f = _make_patches(sc.tpl_f.astype(jnp.int32), sc.trans_f,
+                              sc.trans_table, jnp.int32(L),
+                              jnp.asarray(pos_f), jnp.asarray(mtype),
+                              jnp.asarray(base_f))
+    patches_r = _make_patches(sc.tpl_r.astype(jnp.int32), sc.trans_r,
+                              sc.trans_table, jnp.int32(L),
+                              jnp.asarray(pos_r), jnp.asarray(mtype),
+                              jnp.asarray(base_r))
+    for r in range(sc.n_reads):
+        ts, te, strand = int(sc._tstarts[r]), int(sc._tends[r]), int(sc._strands[r])
+        wlen = te - ts
+        p_w = np.where(strand == 0, pos_f - ts, te - end_f)
+        e_w = np.where(strand == 0, end_f - ts, te - pos_f)
+        is_ins = mtype == ms.INS
+        overlap = np.where(is_ins, (ts <= end_f) & (pos_f <= te),
+                           (ts < end_f) & (pos_f < te))
+        edge = overlap & ~((p_w >= 3) & (e_w <= wlen - 2)) & (wlen >= 8)
+        a = jax.tree.map(lambda x: x[r], sc.alpha)
+        b = jax.tree.map(lambda x: x[r], sc.beta)
+
+        fast = np.asarray(ms.edge_read_scores_fast(
+            jnp.asarray(sc._reads[r]), jnp.int32(sc._rlens[r]),
+            jnp.int32(strand), jnp.int32(ts), jnp.int32(te),
+            sc.win_tpl[r], sc.win_trans[r], sc.wlens[r],
+            a, b, sc.a_prefix[r], sc.b_suffix[r],
+            jnp.asarray(pos_f), jnp.asarray(end_f), jnp.asarray(mtype),
+            patches_f, patches_r))
+
+        def refill_one(pf, ef, mt, patf, patr):
+            p = jnp.where(strand == 0, pf - ts, te - ef)
+            patch = jax.tree.map(
+                lambda x, y: jnp.where(strand == 0, x, y), patf, patr)
+            return ms.full_refill_score(
+                jnp.asarray(sc._reads[r]).astype(jnp.int32),
+                jnp.int32(sc._rlens[r]), sc.win_tpl[r].astype(jnp.int32),
+                sc.win_trans[r], sc.wlens[r], p, mt, patch, sc._W)
+
+        slow = np.asarray(jax.vmap(refill_one)(
+            jnp.asarray(pos_f), jnp.asarray(end_f), jnp.asarray(mtype),
+            patches_f, patches_r))
+        diff = np.abs(np.where(edge, slow - fast, 0.0))
+        assert edge.sum() > 0
+        assert diff.max() < 2e-3, (r, diff.max(), int(np.argmax(diff)))
+
+
 def test_mutated_windows_per_pair_matches_mutated_window(zmw_state):
     sc, muts, (pos_f, _, mtype, _, _, _), (patches_f, _) = zmw_state
     r = 0
