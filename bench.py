@@ -18,7 +18,7 @@ so the baseline is measured on a faithful reimplementation.
 Usage:
   python bench.py                      # bench on the default jax platform
   python bench.py --record-cpu-baseline  # measure + store the CPU baseline
-Env knobs: BENCH_ZMWS (32), BENCH_TPL_LEN (300), BENCH_PASSES (8),
+Env knobs: BENCH_ZMWS (128), BENCH_TPL_LEN (300), BENCH_PASSES (8),
 BENCH_CORRUPTIONS (2).
 """
 
@@ -72,10 +72,15 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int):
     run_workload(tasks)  # warmup: compiles every program at bucket shapes
     warm_s = time.monotonic() - t0
 
-    tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corruptions)
-    t0 = time.monotonic()
-    polisher, results, qvs = run_workload(tasks)
-    bench_s = time.monotonic() - t0
+    # best of two timed runs: the device link (tunneled on dev hosts) has
+    # latency spikes that can halve a single run's throughput
+    bench_s = float("inf")
+    for _ in range(2):
+        tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes,
+                                    n_corruptions)
+        t0 = time.monotonic()
+        polisher, results, qvs = run_workload(tasks)
+        bench_s = min(bench_s, time.monotonic() - t0)
 
     n_exact = sum(bool(np.array_equal(polisher.tpls[z], truths[z]))
                   for z in range(n_zmws))
@@ -104,7 +109,7 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
-    n_zmws = int(os.environ.get("BENCH_ZMWS", 32))
+    n_zmws = int(os.environ.get("BENCH_ZMWS", 128))
     tpl_len = int(os.environ.get("BENCH_TPL_LEN", 300))
     n_passes = int(os.environ.get("BENCH_PASSES", 8))
     n_corr = int(os.environ.get("BENCH_CORRUPTIONS", 2))
@@ -137,7 +142,16 @@ def main() -> None:
     baseline = None
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
-            baseline = json.load(f).get("cpu_zmws_per_sec")
+            rec = json.load(f)
+        this_config = {"n_zmws": n_zmws, "tpl_len": tpl_len,
+                       "n_passes": n_passes, "n_corruptions": n_corr}
+        if rec.get("config") == this_config:
+            baseline = rec.get("cpu_zmws_per_sec")
+        else:
+            print(f"bench: recorded CPU baseline config {rec.get('config')} "
+                  f"does not match workload {this_config}; re-record with "
+                  "--record-cpu-baseline (vs_baseline -> 1.0)",
+                  file=sys.stderr)
 
     vs_baseline = (stats["zmws_per_sec"] / baseline) if baseline else 1.0
     print(json.dumps({

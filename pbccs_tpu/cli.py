@@ -73,8 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--numThreads", type=int, default=0,
                    help="Number of host pipeline threads (0 = auto). "
                         "Default = %(default)s")
-    p.add_argument("--chunkSize", type=int, default=4,
-                   help="ZMWs per work item. Default = %(default)s")
+    p.add_argument("--chunkSize", type=int, default=64,
+                   help="ZMWs per work item; each work item polishes as one "
+                        "lockstep device batch. Default = %(default)s")
     p.add_argument("--logFile", default=None, help="Log to a file vs stderr.")
     p.add_argument("--logLevel", default="INFO",
                    help="TRACE..FATAL. Default = %(default)s")

@@ -629,14 +629,22 @@ class BatchPolisher:
 
     # ------------------------------------------------------------- refinement
 
-    def refine(self, opts: RefineOptions | None = None) -> list[RefineResult]:
-        """Lockstep greedy refinement across the batch."""
+    def refine(self, opts: RefineOptions | None = None,
+               skip=None) -> list[RefineResult]:
+        """Lockstep greedy refinement across the batch.
+
+        ZMW indices in `skip` take no part in refinement (their RefineResult
+        stays non-converged): the pipeline excludes ZMWs that already failed
+        a yield gate so their slots cost no mutation work and their templates
+        cannot grow the bucket."""
         opts = opts or RefineOptions()
         Z = self.n_zmws
         results = [RefineResult(converged=False) for _ in range(Z)]
         history: list[set[int]] = [set() for _ in range(Z)]
         favorable: list[list[mutlib.Mutation]] = [[] for _ in range(Z)]
         done = np.zeros(Z, bool)
+        for z in (skip or ()):
+            done[z] = True
 
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         for it in range(opts.max_iterations):
@@ -689,11 +697,14 @@ class BatchPolisher:
 
     # ------------------------------------------------------------------- QVs
 
-    def consensus_qvs(self) -> list[np.ndarray]:
+    def consensus_qvs(self, skip=None) -> list[np.ndarray]:
         """Per-ZMW per-position QVs (parity: ConsensusQVs,
-        Consensus-inl.hpp:277-297), one batched sweep."""
-        arrs = [mutlib.enumerate_unique_arrays(t)
-                for t in self.tpls[: self.n_zmws]]
+        Consensus-inl.hpp:277-297), one batched sweep.  ZMWs in `skip` get
+        empty QV arrays and cost no device work."""
+        skip = skip or ()
+        empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
+        arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
+                for z, t in enumerate(self.tpls[: self.n_zmws])]
         scores = self.score_mutation_arrays(arrs)
         out = []
         for z in range(self.n_zmws):

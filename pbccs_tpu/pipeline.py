@@ -232,10 +232,22 @@ def extract_mapped_read(read: Subread, summary: PoaAlignmentSummary,
     return MappedRead(read.id, seq, strand, ts, te, read.is_full_pass)
 
 
-def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
-                  ) -> tuple[Failure, ConsensusResult | None]:
-    """The per-ZMW pipeline (reference Consensus, Consensus.h:396-553)."""
-    settings = settings or ConsensusSettings()
+@dataclasses.dataclass
+class PreparedZmw:
+    """One ZMW past the filter/draft/mapping stages, ready to polish."""
+
+    chunk: Chunk
+    css: np.ndarray
+    mapped: list[MappedRead]
+    n_candidates: int
+    n_unmappable: int
+    prep_ms: float
+
+
+def prepare_chunk(chunk: Chunk, settings: ConsensusSettings
+                  ) -> tuple[Failure | None, PreparedZmw | None]:
+    """Filter -> POA draft -> read mapping (the host stages of the per-ZMW
+    pipeline, reference Consensus.h:396-434)."""
     t0 = time.monotonic()
 
     if float(np.min(chunk.snr)) < settings.min_snr:
@@ -265,19 +277,21 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
     if not mapped:
         return Failure.NO_SUBREADS, None
 
-    scorer = ArrowMultiReadScorer(
-        css, chunk.snr,
-        [m.seq for m in mapped],
-        [m.strand for m in mapped],
-        [m.tpl_start for m in mapped],
-        [m.tpl_end for m in mapped],
-        min_zscore=settings.min_zscore)
+    prep_ms = (time.monotonic() - t0) * 1e3
+    return None, PreparedZmw(chunk, css, mapped, n_candidates,
+                             n_unmappable, prep_ms)
 
+
+def _read_gates(prep: PreparedZmw, statuses: np.ndarray,
+                settings: ConsensusSettings
+                ) -> tuple[Failure | None, list[int], int]:
+    """Post-AddRead yield gates (reference Consensus.h:437-471): returns
+    (failure or None, per-status counts, usable full passes)."""
     status_counts = [0] * 5
     n_passes = 0
-    n_dropped = n_unmappable
-    for i, m in enumerate(mapped):
-        st = int(scorer.statuses[i])
+    n_dropped = prep.n_unmappable
+    for i, m in enumerate(prep.mapped):
+        st = int(statuses[i])
         status_counts[st] += 1
         if st == ADD_SUCCESS and m.is_full_pass:
             n_passes += 1
@@ -285,59 +299,164 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
             n_dropped += 1
 
     if n_passes < settings.min_passes:
-        return Failure.TOO_FEW_PASSES, None
+        return Failure.TOO_FEW_PASSES, status_counts, n_passes
+    if prep.n_candidates > 0 and \
+            n_dropped / prep.n_candidates > settings.max_drop_fraction:
+        return Failure.TOO_MANY_UNUSABLE, status_counts, n_passes
+    return None, status_counts, n_passes
 
-    if n_candidates > 0 and n_dropped / n_candidates > settings.max_drop_fraction:
-        return Failure.TOO_MANY_UNUSABLE, None
 
-    # original z-score stats before refinement
-    zs = scorer.zscores[np.isfinite(scorer.zscores)]
-    avg_z = float(zs.mean()) if len(zs) else float("nan")
-    global_z = scorer.global_zscore()
-
-    refine = refine_consensus(scorer, settings.refine)
+def _finish_zmw(prep: PreparedZmw, settings: ConsensusSettings,
+                tpl: np.ndarray, qvs: np.ndarray, refine,
+                zscores: np.ndarray, global_z: float,
+                status_counts: list[int], n_passes: int,
+                elapsed_ms: float) -> tuple[Failure, ConsensusResult | None]:
+    """Post-polish yield gates + result assembly
+    (reference Consensus.h:497-553)."""
     if not refine.converged:
         return Failure.NON_CONVERGENT, None
 
-    qvs = scorer.consensus_qvs()
     pred_acc = predicted_accuracy(qvs)
     if pred_acc < settings.min_predicted_accuracy:
         return Failure.POOR_QUALITY, None
 
-    sequence = decode_bases(scorer.tpl)
+    sequence = decode_bases(tpl)
     if len(sequence) != len(qvs):  # invalid bases reached the template
         return Failure.OTHER, None
 
-    elapsed_ms = (time.monotonic() - t0) * 1e3
+    zs = zscores[np.isfinite(zscores)]
+    avg_z = float(zs.mean()) if len(zs) else float("nan")
     return Failure.SUCCESS, ConsensusResult(
-        id=chunk.id,
+        id=prep.chunk.id,
         sequence=sequence,
         qvs=qvs,
         num_passes=n_passes,
         predicted_accuracy=pred_acc,
         global_zscore=global_z,
         avg_zscore=avg_z,
-        zscores=scorer.zscores.copy(),
+        zscores=zscores.copy(),
         status_counts=status_counts,
         mutations_tested=refine.n_tested,
         mutations_applied=refine.n_applied,
-        snr=np.asarray(chunk.snr),
+        snr=np.asarray(prep.chunk.snr),
         elapsed_ms=elapsed_ms)
 
 
+def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
+                  ) -> tuple[Failure, ConsensusResult | None]:
+    """The per-ZMW pipeline (reference Consensus, Consensus.h:396-553)."""
+    settings = settings or ConsensusSettings()
+    t0 = time.monotonic()
+
+    failure, prep = prepare_chunk(chunk, settings)
+    if failure is not None:
+        return failure, None
+
+    scorer = ArrowMultiReadScorer(
+        prep.css, chunk.snr,
+        [m.seq for m in prep.mapped],
+        [m.strand for m in prep.mapped],
+        [m.tpl_start for m in prep.mapped],
+        [m.tpl_end for m in prep.mapped],
+        min_zscore=settings.min_zscore)
+
+    failure, status_counts, n_passes = _read_gates(prep, scorer.statuses,
+                                                   settings)
+    if failure is not None:
+        return failure, None
+
+    global_z = scorer.global_zscore()
+    refine = refine_consensus(scorer, settings.refine)
+    if not refine.converged:
+        return Failure.NON_CONVERGENT, None
+    qvs = scorer.consensus_qvs()
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    return _finish_zmw(prep, settings, scorer.tpl, qvs, refine,
+                       scorer.zscores, global_z, status_counts, n_passes,
+                       elapsed_ms)
+
+
 def process_chunks(chunks: Sequence[Chunk],
-                   settings: ConsensusSettings | None = None) -> ResultTally:
+                   settings: ConsensusSettings | None = None,
+                   batch_polish: bool = True) -> ResultTally:
     """Process a batch of ZMWs; exceptions become Other tallies and the batch
-    continues (reference Consensus.h:543-548)."""
+    continues (reference Consensus.h:543-548).
+
+    With batch_polish (the default), all ZMWs that survive the host stages
+    polish together in one lockstep BatchPolisher -- the TPU execution model
+    (one batched device program per refinement round) instead of the
+    reference's one-thread-per-ZMW loop.  Any polish-stage error falls back
+    to the serial per-ZMW path to preserve fault isolation."""
     settings = settings or ConsensusSettings()
     tally = ResultTally()
+    if not batch_polish:
+        for chunk in chunks:
+            try:
+                failure, result = process_chunk(chunk, settings)
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                tally.tally(Failure.OTHER)
+                continue
+            tally.tally(failure)
+            if result is not None:
+                tally.results.append(result)
+        return tally
+
+    preps: list[PreparedZmw] = []
     for chunk in chunks:
         try:
-            failure, result = process_chunk(chunk, settings)
+            failure, prep = prepare_chunk(chunk, settings)
         except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
             tally.tally(Failure.OTHER)
             continue
-        tally.tally(failure)
-        if result is not None:
-            tally.results.append(result)
-    return tally
+        if failure is not None:
+            tally.tally(failure)
+        else:
+            preps.append(prep)
+    if not preps:
+        return tally
+
+    try:
+        t0 = time.monotonic()
+        from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+
+        tasks = [ZmwTask(p.chunk.id, p.css, np.asarray(p.chunk.snr),
+                         [m.seq for m in p.mapped],
+                         [m.strand for m in p.mapped],
+                         [m.tpl_start for m in p.mapped],
+                         [m.tpl_end for m in p.mapped]) for p in preps]
+        polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore)
+        gate_info = []
+        for z, p in enumerate(preps):
+            gate_info.append(_read_gates(p, polisher.statuses[z], settings))
+        # gate-failed ZMWs are excluded from refinement/QV (the serial path
+        # returns before polishing them); their batch slots stay idle
+        skip = {z for z, g in enumerate(gate_info) if g[0] is not None}
+        # z-score statistics are reported for the draft template, before
+        # refinement (parity with the serial path)
+        global_zs = polisher.global_zscores()
+        refine_results = polisher.refine(settings.refine, skip=skip)
+        qvs = polisher.consensus_qvs(skip=skip)
+        polish_ms = (time.monotonic() - t0) * 1e3 / max(len(preps), 1)
+
+        # tallies accumulate into a local batch tally so a mid-loop fault
+        # cannot double-count ZMWs when the serial fallback reruns them
+        bt = ResultTally()
+        for z, p in enumerate(preps):
+            failure, status_counts, n_passes = gate_info[z]
+            if failure is not None:
+                bt.tally(failure)
+                continue
+            nr = len(p.mapped)
+            failure, result = _finish_zmw(
+                p, settings, polisher.tpls[z], qvs[z], refine_results[z],
+                polisher.zscores[z, :nr], global_zs[z], status_counts,
+                n_passes, p.prep_ms + polish_ms)
+            bt.tally(failure)
+            if result is not None:
+                bt.results.append(result)
+        tally.merge(bt)
+        return tally
+    except Exception:  # noqa: BLE001 -- isolate faults via the serial path
+        tally.merge(process_chunks([p.chunk for p in preps], settings,
+                                   batch_polish=False))
+        return tally

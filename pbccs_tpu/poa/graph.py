@@ -269,8 +269,10 @@ class PoaGraph:
         reach = {}
         best_prev = {}
         best_v, best_score = -1, -np.inf
+        self.vertex_score = np.zeros(len(self.base), np.float32)
         for v in order:
             score = 2.0 * self.nreads[v] - max(self.spanning[v], min_coverage) - 1e-4
+            self.vertex_score[v] = score
             r = score
             bp = -1
             for p in self.preds[v]:
@@ -290,6 +292,68 @@ class PoaGraph:
             v = best_prev[v]
         path.reverse()
         return path
+
+    def find_possible_variants(self, best_path: list[int]):
+        """Scored candidate variants of the consensus path read off the graph
+        topology (parity: PoaGraphImpl::findPossibleVariants, reference
+        PoaGraphTraversals.cpp:396-498): for each interior path vertex,
+
+        * an edge path[i] -> path[i+2] suggests DELETING path position i+1
+          (score = -vertex score of the skipped vertex);
+        * a vertex that is both child of path[i] and parent of path[i+1]
+          suggests INSERTING its base before position i+1;
+        * an off-path vertex that is child of path[i] and parent of
+          path[i+2] suggests SUBSTITUTING it at position i+1.
+
+        Ties between candidate vertices break toward the lower vertex id.
+        Requires consensus_path() to have been run (vertex scores).
+        Returns a list of scored mutations in template coordinates.
+        """
+        from pbccs_tpu.models.arrow import mutations as mutlib
+
+        if not hasattr(self, "vertex_score"):
+            raise RuntimeError("run consensus_path() before "
+                               "find_possible_variants()")
+        variants: list[mutlib.Mutation] = []
+        for i in range(2, len(best_path) - 2):
+            v = best_path[i]
+            children = self.succs[v]
+
+            if best_path[i + 2] in children:
+                score = -float(self.vertex_score[best_path[i + 1]])
+                variants.append(
+                    mutlib.deletion(i + 1).with_score(score))
+
+            look_back = self.preds[best_path[i + 1]]
+            best = -1
+            for c in children:
+                if c in look_back and (
+                        best < 0
+                        or self.vertex_score[c] > self.vertex_score[best]
+                        or (self.vertex_score[c] == self.vertex_score[best]
+                            and c < best)):
+                    best = c
+            if best >= 0:
+                variants.append(
+                    mutlib.insertion(i + 1, self.base[best])
+                    .with_score(float(self.vertex_score[best])))
+
+            look_back = self.preds[best_path[i + 2]]
+            best = -1
+            for c in children:
+                if c == best_path[i + 1]:
+                    continue
+                if c in look_back and (
+                        best < 0
+                        or self.vertex_score[c] > self.vertex_score[best]
+                        or (self.vertex_score[c] == self.vertex_score[best]
+                            and c < best)):
+                    best = c
+            if best >= 0:
+                variants.append(
+                    mutlib.substitution(i + 1, self.base[best])
+                    .with_score(float(self.vertex_score[best])))
+        return variants
 
 
     def write_graphviz(self, fh, consensus_vertices=None) -> None:

@@ -51,6 +51,7 @@ class SparsePoa:
         """Returns (consensus codes, per-read PoaAlignmentSummary list)
         (reference SparsePoa.cpp:139-199)."""
         path = self.graph.consensus_path(min_coverage)
+        self.last_consensus_path = path
         css = np.asarray([self.graph.base[v] for v in path], np.int8)
         css_position = {v: i for i, v in enumerate(path)}
 

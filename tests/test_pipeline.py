@@ -150,3 +150,26 @@ def test_process_chunks_tally(rng):
     assert len(tally.results) == 2
     ids = {r.id for r in tally.results}
     assert ids == {"movie/1", "movie/2"}
+
+
+def test_batch_polish_matches_serial(rng):
+    """The lockstep batched polish path produces the same consensus,
+    QVs, gates, and yield counts as the serial per-ZMW path."""
+    chunks = []
+    for i in range(4):
+        _, chunk = make_chunk(rng, zmw_id=f"bp/{i}", tpl_len=100,
+                              n_passes=6 if i != 1 else 2)
+        chunks.append(chunk)
+    serial = process_chunks(chunks, batch_polish=False)
+    batched = process_chunks(chunks, batch_polish=True)
+    assert {f: c for f, c in serial.counts.items()} == \
+        {f: c for f, c in batched.counts.items()}
+    assert len(serial.results) == len(batched.results)
+    for rs, rb in zip(serial.results, batched.results):
+        assert rs.id == rb.id
+        assert rs.sequence == rb.sequence
+        np.testing.assert_array_equal(rs.qvs, rb.qvs)
+        assert rs.num_passes == rb.num_passes
+        assert rs.status_counts == rb.status_counts
+        assert abs(rs.predicted_accuracy - rb.predicted_accuracy) < 1e-9
+        assert abs(rs.global_zscore - rb.global_zscore) < 1e-6

@@ -87,3 +87,39 @@ def test_staggered_local_reads():
     # middle read maps fully onto the consensus
     rs, re_ = summaries[1].extent_on_read
     assert (rs, re_) == (0, 80)
+
+
+def test_find_possible_variants():
+    """Minority alleles left in the graph surface as scored variant
+    candidates (reference PoaGraphTraversals.cpp:396-498 via
+    TestPoaConsensus mutation-seeding patterns)."""
+    from pbccs_tpu.models.arrow.mutations import (
+        DELETION, INSERTION, SUBSTITUTION)
+
+    base = encode_bases("ACGTACGTTGCAACGTACGT")
+    sub = base.copy()
+    sub[8] = (sub[8] + 2) % 4          # minority substitution
+    dele = np.delete(base, 12)          # minority deletion
+    ins = np.insert(base, 5, 3)         # minority insertion
+
+    poa = SparsePoa()
+    for r in (base, base, base, sub, dele, ins):
+        assert poa.orient_and_add_read(r) >= 0
+    css, _ = poa.find_consensus(min_coverage=2)
+    assert decode_bases(css) == decode_bases(base)
+
+    variants = poa.graph.find_possible_variants(poa.last_consensus_path)
+    kinds = {(m.mtype, m.start) for m in variants}
+    assert (SUBSTITUTION, 8) in kinds
+    # deleted base sits in an "AA" homopolymer: either coordinate is the edit
+    assert (DELETION, 11) in kinds or (DELETION, 12) in kinds
+    assert (INSERTION, 5) in kinds
+
+
+def test_find_possible_variants_requires_consensus():
+    from pbccs_tpu.poa.graph import PoaGraph
+
+    g = PoaGraph()
+    g.add_first_read(encode_bases("ACGTAA"))
+    with pytest.raises(RuntimeError):
+        g.find_possible_variants([0, 1, 2, 3])
