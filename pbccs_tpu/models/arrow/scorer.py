@@ -54,6 +54,18 @@ ADD_SUCCESS, ADD_ALPHABETAMISMATCH, ADD_MEM_FAIL, ADD_POOR_ZSCORE, ADD_OTHER = r
 _AB_MISMATCH_TOL = 1e-3  # reference SimpleRecursor.cpp:53
 
 
+def mated_mask(ll_a, ll_b, rlens, tstarts, tends):
+    """Reads whose alpha/beta fills mate: |1 - LL_a/LL_b| within tolerance,
+    both finite, and band shift representable (reads whose band advances
+    more than _MAX_SHIFT rows/column are dropped deterministically -- the
+    reference's AlphaBetaMismatch drop, SimpleRecursor.cpp:683-688).
+    All args are host numpy arrays with matching leading shape."""
+    mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
+    mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
+    mated &= rlens <= _MAX_BAND_SHIFT * np.maximum(tends - tstarts, 1)
+    return mated
+
+
 
 
 
@@ -323,14 +335,7 @@ class ArrowMultiReadScorer:
         ll_a = np.asarray(ll_a, np.float64)
         ll_b = np.asarray(ll_b, np.float64)
         self.baselines = ll_b
-        mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
-        mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
-        # reads whose band advances >MAX_SHIFT rows/column are outside the
-        # shift-select range of both the Pallas fill kernel and the
-        # gather-free interior scorer; drop them deterministically on every
-        # path (the reference drops such reads via AlphaBetaMismatch too)
-        mated &= self._rlens <= _MAX_BAND_SHIFT * np.maximum(
-            self._tends - self._tstarts, 1)
+        mated = mated_mask(ll_a, ll_b, self._rlens, self._tstarts, self._tends)
 
         mu, var = _read_moments(
             jnp.asarray(self._strands), jnp.asarray(self._tstarts),

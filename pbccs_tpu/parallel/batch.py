@@ -39,11 +39,10 @@ from pbccs_tpu.models.arrow.scorer import (
     ADD_ALPHABETAMISMATCH,
     ADD_POOR_ZSCORE,
     ADD_SUCCESS,
-    _AB_MISMATCH_TOL,
-    _MAX_BAND_SHIFT,
     fill_alpha_beta_batch,
     fills_use_pallas,
     interior_read_scores,
+    mated_mask,
     oriented_window,
     window_moments,
 )
@@ -125,6 +124,15 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
     return (win_tpl, win_trans, wlens, alpha, beta,
             unflat(ll_a), unflat(ll_b), unflat(apre), unflat(bsuf),
             trans_f, tpl_r, trans_r, table, mu, var)
+
+
+@jax.jit
+def _scatter_z(full, subset, idx):
+    """full[leaf][idx[k]] = subset[leaf][k] for every pytree leaf; OOB pad
+    indices are dropped."""
+    return jax.tree.map(
+        lambda f, s: f.at[idx].set(s.astype(f.dtype), mode="drop"),
+        full, subset)
 
 
 @jax.jit
@@ -372,11 +380,7 @@ class BatchPolisher:
         self._baselines_dev = self._shard(ll_b, 1)
         self._ll_mu = np.asarray(mu, np.float64)
         self._ll_var = np.asarray(var, np.float64)
-        mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
-        mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
-        # see ArrowMultiReadScorer._rebuild: band-shift overflow drop
-        mated &= self._rlens <= _MAX_BAND_SHIFT * np.maximum(
-            self._tends - self._tstarts, 1)
+        mated = mated_mask(ll_a, ll_b, self._rlens, self._tstarts, self._tends)
 
         real = np.zeros((self._Z, self._R), bool)
         for z in range(self.n_zmws):
@@ -395,6 +399,59 @@ class BatchPolisher:
         else:
             self.active &= mated
             self.active &= real
+
+    def _setup_partial(self, changed: list[int]) -> None:
+        """Refill only the ZMWs whose template changed this round, scattering
+        the new windows/fills into the cached device state.  Late refinement
+        rounds typically mutate a small fraction of the batch, and the full
+        (Z, R) refill was a profiled per-round cost."""
+        tl, tlens = self._template_arrays()
+        self._tlens = tlens
+        Zc = next_pow2(len(changed), 4)
+        idx = np.full(Zc, self._Z, np.int32)      # OOB pad -> dropped scatter
+        idx[: len(changed)] = changed
+        safe = np.clip(idx, 0, self._Z - 1)
+        g = lambda a: jnp.asarray(np.asarray(a)[safe])
+
+        sub = _batch_setup(
+            g(tl), g(tlens), g(self._host_tables),
+            g(self._reads), g(self._rlens), g(self._strands),
+            g(self._tstarts), g(self._tends), self._W,
+            use_pallas=fills_use_pallas())
+        (w_tpl, w_trans, wlens, s_alpha, s_beta, ll_a, ll_b, apre, bsuf,
+         trans_f, tpl_r, trans_r, _table, mu, var) = sub
+
+        full = (self.win_tpl, self.win_trans, self.wlens, self.alpha,
+                self.beta, self.a_prefix, self.b_suffix, self.trans_f,
+                self.tpl_r, self.trans_r)
+        subset = (w_tpl, w_trans, wlens, s_alpha, s_beta, apre, bsuf,
+                  trans_f, tpl_r, trans_r)
+        (self.win_tpl, self.win_trans, self.wlens, self.alpha, self.beta,
+         self.a_prefix, self.b_suffix, self.trans_f, self.tpl_r,
+         self.trans_r) = _scatter_z(full, subset, jnp.asarray(idx))
+
+        self._tstarts_dev = self._shard(self._tstarts, 1)
+        self._tends_dev = self._shard(self._tends, 1)
+        self._tlens_dev = self._shard(tlens)
+        tl_dev = jnp.asarray(tl)
+        self._tpl_dev = tl_dev
+        self._tpl32_dev = tl_dev.astype(jnp.int32)
+        self._tpl32_r_dev = self.tpl_r.astype(jnp.int32)
+
+        ll_a = np.asarray(ll_a, np.float64)[: len(changed)]
+        ll_b = np.asarray(ll_b, np.float64)[: len(changed)]
+        zs = np.asarray(changed)
+        self.baselines[zs] = ll_b
+        self._baselines_dev = self._shard(self.baselines, 1)
+        self._ll_mu[zs] = np.asarray(mu, np.float64)[: len(changed)]
+        self._ll_var[zs] = np.asarray(var, np.float64)[: len(changed)]
+
+        mated = mated_mask(ll_a, ll_b, self._rlens[zs], self._tstarts[zs],
+                           self._tends[zs])
+        real = np.zeros_like(mated)
+        for k, z in enumerate(changed):
+            real[k, : self._n_reads[z]] = True
+        self.active[zs] &= mated & real
 
     # ---------------------------------------------------------------- scoring
 
@@ -610,11 +667,11 @@ class BatchPolisher:
     def apply_mutations(self, best_per_zmw: Sequence[Sequence[mutlib.Mutation]]
                         ) -> None:
         """Splice per-ZMW mutations, remap read windows, rebuild fills."""
-        changed = False
+        changed: list[int] = []
         for z, best in enumerate(best_per_zmw):
             if not best:
                 continue
-            changed = True
+            changed.append(z)
             L = len(self.tpls[z])
             mtp = mutlib.target_to_query_positions(best, L)
             self.tpls[z] = mutlib.apply_mutations(self.tpls[z], best)
@@ -623,9 +680,16 @@ class BatchPolisher:
         if not changed:
             return
         max_l = max(len(t) for t in self.tpls)
-        if max_l + 2 > self._Jmax:
+        rebucket = max_l + 2 > self._Jmax
+        if rebucket:
             self._Jmax = pad_to(max_l + 16, 64)  # rebucket (recompiles)
-        self._setup(first=False)
+        # partial refill when a minority of ZMWs changed (mesh runs always
+        # rebuild in full: the compacted sub-batch breaks the sharding)
+        if (self.mesh is None and not rebucket
+                and len(changed) * 2 <= self.n_zmws):
+            self._setup_partial(changed)
+        else:
+            self._setup(first=False)
 
     # ------------------------------------------------------------- refinement
 
@@ -705,9 +769,13 @@ class BatchPolisher:
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
                 for z, t in enumerate(self.tpls[: self.n_zmws])]
+        skipped = [z in skip for z in range(self.n_zmws)]
         scores = self.score_mutation_arrays(arrs)
         out = []
         for z in range(self.n_zmws):
+            if skipped[z]:
+                out.append(np.zeros(0, np.int32))
+                continue
             ssum = np.zeros(len(self.tpls[z]))
             neg = scores[z] < 0.0
             np.add.at(ssum, arrs[z].start[neg], np.exp(scores[z][neg]))

@@ -435,6 +435,10 @@ def process_chunks(chunks: Sequence[Chunk],
         # refinement (parity with the serial path)
         global_zs = polisher.global_zscores()
         refine_results = polisher.refine(settings.refine, skip=skip)
+        # non-converged ZMWs are discarded by _finish_zmw; don't pay the QV
+        # sweep (the most expensive single pass) for them
+        skip = skip | {z for z, r in enumerate(refine_results)
+                       if not r.converged}
         qvs = polisher.consensus_qvs(skip=skip)
         polish_ms = (time.monotonic() - t0) * 1e3 / max(len(preps), 1)
 

@@ -65,6 +65,10 @@ class PoaGraph:
     # ------------------------------------------------------------- plumbing
 
     def _add_vertex(self, base: int) -> int:
+        # any graph mutation invalidates the consensus-path vertex scores
+        # (find_possible_variants must see scores for the current topology)
+        if hasattr(self, "vertex_score"):
+            del self.vertex_score
         v = len(self.base)
         self.base.append(int(base))
         self.nreads.append(1)
@@ -208,6 +212,8 @@ class PoaGraph:
 
             if i > 0 and cell == m_val:
                 if read[i - 1] == vb:
+                    if hasattr(self, "vertex_score"):
+                        del self.vertex_score  # coverage changed
                     self.nreads[v] += 1
                     if fork >= 0:
                         self._add_edge(v, fork)
@@ -312,8 +318,9 @@ class PoaGraph:
         from pbccs_tpu.models.arrow import mutations as mutlib
 
         if not hasattr(self, "vertex_score"):
-            raise RuntimeError("run consensus_path() before "
-                               "find_possible_variants()")
+            raise RuntimeError(
+                "run consensus_path() (after the last graph change) before "
+                "find_possible_variants()")
         variants: list[mutlib.Mutation] = []
         for i in range(2, len(best_path) - 2):
             v = best_path[i]

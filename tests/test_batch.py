@@ -90,3 +90,39 @@ def test_batch_global_zscores_finite(rng):
     gz = batch.global_zscores()
     assert gz.shape == (2,)
     assert np.isfinite(gz).all()
+
+
+def test_partial_refill_matches_full(rng):
+    """Refilling only changed ZMWs after apply_mutations produces the same
+    templates, QVs, and convergence as the always-full rebuild."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+
+    def build(seed):
+        r = np.random.default_rng(seed)
+        tasks = []
+        for z in range(6):
+            tpl, reads, strands, snr = simulate_zmw(r, 120, 5)
+            draft = tpl.copy()
+            draft[30 + z] = (draft[30 + z] + 1) % 4
+            tasks.append(ZmwTask(f"pr/{z}", draft, snr, reads, strands,
+                                 [0] * len(reads), [len(draft)] * len(reads)))
+        return tasks
+
+    pol_full = BatchPolisher(build(7))
+    orig = BatchPolisher._setup_partial
+    BatchPolisher._setup_partial = \
+        lambda self, ch: BatchPolisher._setup(self, first=False)
+    try:
+        res_full = pol_full.refine(RefineOptions(max_iterations=6))
+        qv_full = pol_full.consensus_qvs()
+    finally:
+        BatchPolisher._setup_partial = orig
+
+    pol_part = BatchPolisher(build(7))
+    res_part = pol_part.refine(RefineOptions(max_iterations=6))
+    qv_part = pol_part.consensus_qvs()
+
+    for z in range(6):
+        np.testing.assert_array_equal(pol_full.tpls[z], pol_part.tpls[z])
+        np.testing.assert_array_equal(qv_full[z], qv_part[z])
+        assert res_full[z].converged == res_part[z].converged

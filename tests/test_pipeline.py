@@ -161,7 +161,20 @@ def test_batch_polish_matches_serial(rng):
                               n_passes=6 if i != 1 else 2)
         chunks.append(chunk)
     serial = process_chunks(chunks, batch_polish=False)
-    batched = process_chunks(chunks, batch_polish=True)
+    # guard against a vacuous pass: if the batched path raised and fell back
+    # to the serial loop, this patched process_chunk turns every ZMW into an
+    # Other tally and the count comparison below fails
+    import pbccs_tpu.pipeline as _pl
+
+    def _boom(*a, **k):
+        raise AssertionError("batched path fell back to serial")
+
+    orig = _pl.process_chunk
+    _pl.process_chunk = _boom
+    try:
+        batched = process_chunks(chunks, batch_polish=True)
+    finally:
+        _pl.process_chunk = orig
     assert {f: c for f, c in serial.counts.items()} == \
         {f: c for f, c in batched.counts.items()}
     assert len(serial.results) == len(batched.results)
