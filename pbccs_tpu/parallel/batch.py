@@ -127,6 +127,57 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
 
 
 @jax.jit
+def _stack_chunks(chunks):
+    """Stack per-chunk (Z, M) totals into one (C, Z, M) device array."""
+    return jnp.stack(chunks)
+
+
+def _mated_mask_dev(ll_a, ll_b, rlens, tstarts, tends):
+    """Device-side mated_mask (scorer.mated_mask) so refinement rounds can
+    update the read-active mask without a device->host stats fetch."""
+    from pbccs_tpu.models.arrow.scorer import _AB_MISMATCH_TOL, _MAX_BAND_SHIFT
+
+    mated = jnp.abs(1.0 - ll_a / jnp.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
+    mated &= jnp.isfinite(ll_a) & jnp.isfinite(ll_b)
+    mated &= rlens <= _MAX_BAND_SHIFT * jnp.maximum(tends - tstarts, 1)
+    return mated
+
+
+@jax.jit
+def _update_active(active, ll_a, ll_b, rlens, tstarts, tends):
+    return active & _mated_mask_dev(ll_a, ll_b, rlens, tstarts, tends)
+
+
+@jax.jit
+def _update_active_partial(active, ll_a, ll_b, rlens, tstarts, tends,
+                           real_sub, idx):
+    nz = active.shape[0]
+    prev = active[jnp.clip(idx, 0, nz - 1)]
+    rows = prev & real_sub & _mated_mask_dev(ll_a, ll_b, rlens,
+                                             tstarts, tends)
+    return active.at[idx].set(rows, mode="drop")
+
+
+@jax.jit
+def _fold_edge_slab(totals, et, sel_idx, used):
+    """totals[z, sel_idx[z,k]] += et[z,k] where used — on device, so edge
+    slabs cost no extra device->host fetch (each fetch over the tunneled
+    link costs ~0.1-0.25 s regardless of size)."""
+    upd = jnp.where(used, et, 0.0)
+    z = jnp.arange(totals.shape[0], dtype=jnp.int32)[:, None]
+    return totals.at[z, sel_idx].add(upd)
+
+
+@jax.jit
+def _fold_fallback(totals, ll, baselines, active, ez, er, em, valid):
+    """totals[ez, em] += ll - baselines[ez, er] for fallback pairs (pairs of
+    inactive reads are dropped -- the host pair list is geometry-only)."""
+    base = baselines[ez, er]
+    upd = jnp.where(valid & active[ez, er], ll - base, 0.0)
+    return totals.at[ez, em].add(upd)
+
+
+@jax.jit
 def _scatter_z(full, subset, idx):
     """full[leaf][idx[k]] = subset[leaf][k] for every pytree leaf; OOB pad
     indices are dropped."""
@@ -143,14 +194,16 @@ def _batch_interior_totals(reads, rlens, strands, tstarts, tends,
                            a_prefix, b_suffix, baselines,
                            tpl32_f, trans_f, tpl32_r, trans_r, table, tlens,
                            mpos_f, mend_f, mtype, mbase_f, mpos_r, mbase_r,
-                           int_mask):
+                           int_mask, active):
     """(Z, M) = sum over reads of masked (LL(mut) - baseline), plus the
     fwd/rev virtual-mutation patches (built in the same program: a separate
     patch dispatch per chunk costs two extra device round-trips per
-    refinement round).
+    refinement round).  int_mask is geometry-only; the read-active mask
+    lives on device (active, (Z, R) bool).
 
     The read-axis reduction is the collective: with reads sharded over the
     'read' mesh axis XLA lowers the sum to an all-reduce over ICI."""
+    int_mask = int_mask & active[:, :, None]
 
     def one_patches(t, tr, tb, l, p1, mt1, b1):
         return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
@@ -194,11 +247,13 @@ def _batch_edge_fast_totals(reads, rlens, strands, tstarts, tends,
                             a_prefix, b_suffix, baselines,
                             tpl32_f, trans_f, tpl32_r, trans_r, table, tlens,
                             mpos_f, mend_f, mtype, mbase_f, mpos_r, mbase_r,
-                            edge_mask):
+                            edge_mask, active):
     """(Z, ME) = sum over reads of masked (LL(mut) - baseline) for
     near-window-boundary mutations via the short extension programs
     (ops.mutation_score.edge_scores_fast); same layout/collective shape as
-    _batch_interior_totals."""
+    _batch_interior_totals.  edge_mask is geometry-only; the read-active
+    mask lives on device (active, (Z, R) bool)."""
+    edge_mask = edge_mask & active[:, :, None]
 
     def one_patches(t, tr, tb, l, p1, mt1, b1):
         return make_patches_fast(t, tr, tb, l, p1, mt1, b1)
@@ -304,6 +359,13 @@ class BatchPolisher:
             self._rlens[z, nr:] = 2
             self._tends[z, nr:] = min(2, L)
 
+        # static geometry of real (non-padding) read rows: padding rows get
+        # trivial [0, 2) windows that would otherwise enter the tiny-window
+        # fallback masks on every scoring call
+        self._real_rows = np.zeros((Z, R), bool)
+        for z in range(self.n_zmws):
+            self._real_rows[z, : int(self._n_reads[z])] = True
+
         self.active = np.zeros((Z, R), bool)
         self.statuses = np.full((Z, R), -1, np.int32)
         self.zscores = np.full((Z, R), np.nan)
@@ -374,20 +436,19 @@ class BatchPolisher:
         self._tpl32_dev = self._tpl_dev.astype(jnp.int32)
         self._tpl32_r_dev = self.tpl_r.astype(jnp.int32)
 
-        ll_a = np.asarray(ll_a, np.float64)
-        ll_b = np.asarray(ll_b, np.float64)
-        self.baselines = ll_b
-        self._baselines_dev = self._shard(ll_b, 1)
-        self._ll_mu = np.asarray(mu, np.float64)
-        self._ll_var = np.asarray(var, np.float64)
-        mated = mated_mask(ll_a, ll_b, self._rlens, self._tstarts, self._tends)
-
-        real = np.zeros((self._Z, self._R), bool)
-        for z in range(self.n_zmws):
-            real[z, : self._n_reads[z]] = True
-
+        self._baselines_dev = ll_b
         if first:
-            z = (ll_b - self._ll_mu) / np.sqrt(np.maximum(self._ll_var, 1e-12))
+            # one stacked fetch (device->host transfers cost ~0.1-0.25 s
+            # each over the tunneled link, independent of payload size)
+            stats = np.asarray(jnp.stack([ll_a, ll_b, mu, var]), np.float64)
+            ll_a_h, ll_b_h, mu_h, var_h = stats
+            self.baselines = ll_b_h
+            self._ll_mu = mu_h
+            self._ll_var = var_h
+            mated = mated_mask(ll_a_h, ll_b_h, self._rlens, self._tstarts,
+                               self._tends)
+            real = self._real_rows
+            z = (ll_b_h - self._ll_mu) / np.sqrt(np.maximum(self._ll_var, 1e-12))
             self.zscores = np.where(real & mated, z, np.nan)
             ok_z = np.isnan(self.min_zscore) | (
                 np.isfinite(z) & (z >= self.min_zscore))
@@ -396,9 +457,15 @@ class BatchPolisher:
                 ~real, -1,
                 np.where(~mated, ADD_ALPHABETAMISMATCH,
                          np.where(~ok_z, ADD_POOR_ZSCORE, ADD_SUCCESS)))
+            self._active_dev = self._shard(self.active, 1)
         else:
-            self.active &= mated
-            self.active &= real
+            # refinement-round rebuild: the active-mask update stays on
+            # device (no stats fetch); host copies of baselines/active
+            # reflect the AddRead-time state, which is all the pipeline
+            # reads (statuses/zscores/global z-scores are draft statistics)
+            self._active_dev = _update_active(
+                self._active_dev, ll_a, ll_b, self._rlens_dev,
+                self._tstarts_dev, self._tends_dev)
 
     def _setup_partial(self, changed: list[int]) -> None:
         """Refill only the ZMWs whose template changed this round, scattering
@@ -438,20 +505,13 @@ class BatchPolisher:
         self._tpl32_dev = tl_dev.astype(jnp.int32)
         self._tpl32_r_dev = self.tpl_r.astype(jnp.int32)
 
-        ll_a = np.asarray(ll_a, np.float64)[: len(changed)]
-        ll_b = np.asarray(ll_b, np.float64)[: len(changed)]
-        zs = np.asarray(changed)
-        self.baselines[zs] = ll_b
-        self._baselines_dev = self._shard(self.baselines, 1)
-        self._ll_mu[zs] = np.asarray(mu, np.float64)[: len(changed)]
-        self._ll_var[zs] = np.asarray(var, np.float64)[: len(changed)]
-
-        mated = mated_mask(ll_a, ll_b, self._rlens[zs], self._tstarts[zs],
-                           self._tends[zs])
-        real = np.zeros_like(mated)
-        for k, z in enumerate(changed):
-            real[k, : self._n_reads[z]] = True
-        self.active[zs] &= mated & real
+        self._baselines_dev = _scatter_z(self._baselines_dev, ll_b,
+                                         jnp.asarray(idx))
+        real = self._real_rows[safe]
+        self._active_dev = _update_active_partial(
+            self._active_dev, ll_a, ll_b, g(self._rlens),
+            g(self._tstarts), g(self._tends), jnp.asarray(real),
+            jnp.asarray(idx))
 
     # ---------------------------------------------------------------- scoring
 
@@ -473,9 +533,12 @@ class BatchPolisher:
         e_w = np.where(strand == 0, me - ts, te - ms)
         wlen = te - ts
         interior = (p_w >= 3) & (e_w <= wlen - 2)
-        act = self.active[:, :, None] & valid[:, None, :]
-        int_mask = act & overlap & interior
-        edge_mask = act & overlap & ~interior
+        # geometry-only masks (real read rows only): the read-active mask
+        # stays on device and is ANDed in-program, so refinement rounds need
+        # no active-mask fetch
+        geo = valid[:, None, :] & overlap & self._real_rows[:, :, None]
+        int_mask = geo & interior
+        edge_mask = geo & ~interior
 
         totals_dev, patches_f, patches_r = _batch_interior_totals(
             self._reads_dev, self._rlens_dev,
@@ -489,13 +552,12 @@ class BatchPolisher:
             self.table, self._tlens_dev,
             self._shard(pos_f), self._shard(end_f), self._shard(mtype),
             self._shard(base_f), self._shard(pos_r), self._shard(base_r),
-            self._shard(int_mask, 1))
+            self._shard(int_mask, 1), self._active_dev)
 
         # boundary mutations on adequately long windows: short extension
         # programs over (Z, R, EDGE_SLAB) slabs
         fast_mask = edge_mask & (wlen >= MIN_FAST_EDGE_WLEN)
         fb_mask = edge_mask & (wlen < MIN_FAST_EDGE_WLEN)
-        edge_jobs = []
         em_any = fast_mask.any(axis=1)                      # (Z, M)
         counts = em_any.sum(axis=1)
         if counts.any():
@@ -539,29 +601,15 @@ class BatchPolisher:
                     self._shard(spos_f), self._shard(send_f),
                     self._shard(smtype), self._shard(sbase_f),
                     self._shard(spos_r), self._shard(sbase_r),
-                    self._shard(smask, 1))
-                zz, kk = np.nonzero(used)
-                edge_jobs.append((et_dev, zz, kk, sel_idx))
+                    self._shard(smask, 1), self._active_dev)
+                totals_dev = _fold_edge_slab(totals_dev, et_dev,
+                                             jnp.asarray(sel_idx),
+                                             jnp.asarray(used))
 
-        # tiny-window fallback pairs are resolved at collect time: their
-        # marshalling needs the patch values on host, and syncing here would
-        # serialize the dispatch pipeline (they are rare -- only windows
-        # shorter than MIN_FAST_EDGE_WLEN)
-        fb_state = None
-        if fb_mask.any():
-            fb_state = (np.nonzero(fb_mask), p_w, mtype,
-                        patches_f, patches_r)
-        return totals_dev, edge_jobs, fb_state
-
-    def _collect_chunk(self, state) -> np.ndarray:
-        """Block on one dispatched chunk's device results; (Z, M) totals."""
-        totals_dev, edge_jobs, fb_state = state
-        totals = np.asarray(totals_dev, np.float64)
-        for et_dev, zz, kk, sel_idx in edge_jobs:
-            et = np.asarray(et_dev, np.float64)
-            np.add.at(totals, (zz, sel_idx[zz, kk]), et[zz, kk])
-        if fb_state is not None:
-            (ez_all, er_all, em_all), p_w, mtype, patches_f, patches_r = fb_state
+        # tiny-window fallback pairs: marshalling needs patch values on the
+        # host (one fetch); rare -- only windows below MIN_FAST_EDGE_WLEN
+        ez_all, er_all, em_all = np.nonzero(fb_mask)
+        if len(ez_all):
             pf_b = np.asarray(patches_f.bases)
             pf_t = np.asarray(patches_f.trans)
             pf_s = np.asarray(patches_f.shift)
@@ -584,22 +632,28 @@ class BatchPolisher:
                 pb = np.zeros((Epad, 2), np.int32)
                 ptr = np.zeros((Epad, 2, 4), np.float32)
                 psh = np.zeros(Epad, np.int32)
-                zi[:E], ri[:E] = ez, er
+                mi = np.zeros(Epad, np.int32)
+                ok = np.zeros(Epad, bool)
+                zi[:E], ri[:E], mi[:E], ok[:E] = ez, er, em, True
                 pp[:E] = p_w[ez, er, em]
                 pt[:E] = mtype[ez, em]
                 fwd = self._strands[ez, er] == 0
                 pb[:E] = np.where(fwd[:, None], pf_b[ez, em], pr_b[ez, em])
                 ptr[:E] = np.where(fwd[:, None, None], pf_t[ez, em], pr_t[ez, em])
                 psh[:E] = np.where(fwd, pf_s[ez, em], pr_s[ez, em])
-                edge_ll = np.asarray(_batch_edge(
+                ll_dev = _batch_edge(
                     self._reads_dev, self._rlens_dev,
                     self.win_tpl, self.win_trans, self.wlens,
                     jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(pp),
                     jnp.asarray(pt), jnp.asarray(pb), jnp.asarray(ptr),
                     jnp.asarray(psh), self._W,
-                    fills_use_pallas() and self.mesh is None), np.float64)[:E]
-                np.add.at(totals, (ez, em), edge_ll - self.baselines[ez, er])
-        return totals
+                    fills_use_pallas() and self.mesh is None)
+                totals_dev = _fold_fallback(
+                    totals_dev, ll_dev, self._baselines_dev,
+                    self._active_dev,
+                    jnp.asarray(zi), jnp.asarray(ri), jnp.asarray(mi),
+                    jnp.asarray(ok))
+        return totals_dev
 
     def score_mutation_arrays(self, arrs: Sequence[mutlib.MutationArrays]
                               ) -> list[np.ndarray]:
@@ -647,13 +701,15 @@ class BatchPolisher:
             states.append(self._dispatch_chunk(pos_f, end_f, mtype, base_f,
                                                pos_r, base_r, valid))
 
-        for c, state in enumerate(states):
+        # one stacked fetch for the whole call: every device->host transfer
+        # over the tunneled link costs ~0.1-0.25 s regardless of payload
+        stacked = np.asarray(_stack_chunks(states), np.float64)
+        for c in range(n_chunks):
             lo = c * MUT_CHUNK
-            totals = self._collect_chunk(state)
             for z in range(self.n_zmws):
                 n = min(max(arrs[z].size - lo, 0), MUT_CHUNK)
                 if n > 0:
-                    out[z][lo: lo + n] = totals[z, :n]
+                    out[z][lo: lo + n] = stacked[c, z, :n]
         return out
 
     def score_mutations(self, muts_per_zmw: Sequence[Sequence[mutlib.Mutation]]
