@@ -7,6 +7,11 @@
 //  * Sparse-DP seed chaining (reference include/pacbio/ccs/ChainSeeds.h +
 //    src/ChainSeeds.cpp sweep-line SDP), same link-gain semantics as
 //    pbccs_tpu.align.seeds.chain_seeds, exposed for the host draft stage.
+//  * Partial-order-alignment draft engine (reference ConsensusCore Poa:
+//    PoaGraphImpl alignment/threading/consensus, src/C++/Poa/*), the
+//    behavior-identical native backend of pbccs_tpu.poa.graph.PoaGraph --
+//    the draft stage is the host-side bottleneck once polishing runs on
+//    the accelerator.
 //
 // Exposed as a plain C ABI consumed via ctypes (pbccs_tpu/native.py).
 
@@ -204,6 +209,370 @@ int32_t pbccs_chain_seeds(const int32_t* h, const int32_t* v, int32_t n,
     out_v[i] = static_cast<int32_t>(V[chain[i]]);
   }
   return static_cast<int32_t>(chain.size());
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// POA draft engine.  Behavior-identical native backend of
+// pbccs_tpu.poa.graph.PoaGraph (LOCAL read-vs-DAG alignment with
+// match=+3 / mismatch=-5 / insert=-4 / delete=-4, traceback threading,
+// spanning-read tagging, best-sum consensus path).  All scores are sums of
+// small integers, so float equality in the traceback is exact on both the
+// numpy and native paths.
+// ---------------------------------------------------------------------------
+
+namespace poa {
+
+constexpr float kMatch = 3.0f, kMismatch = -5.0f;
+constexpr float kInsert = -4.0f, kDelete = -4.0f;
+constexpr float kNegInf = -1e30f;
+
+struct Graph {
+  std::vector<int8_t> base;
+  std::vector<int32_t> nreads, spanning;
+  std::vector<std::vector<int32_t>> preds, succs;
+  int32_t n_reads = 0;
+  std::vector<double> score;  // consensus-path vertex scores
+  bool have_scores = false;
+};
+
+struct Plan {
+  float score = kNegInf;
+  int32_t best_vertex = -1, best_row = 0;
+  bool rc = false;
+  std::vector<int8_t> read;           // oriented read
+  std::vector<float> cols;            // V * (I+1)
+  std::vector<int32_t> mpred, dpred;  // V * (I+1)
+};
+
+int32_t AddVertex(Graph& g, int8_t b) {
+  g.have_scores = false;
+  g.base.push_back(b);
+  g.nreads.push_back(1);
+  g.spanning.push_back(0);
+  g.preds.emplace_back();
+  g.succs.emplace_back();
+  return static_cast<int32_t>(g.base.size()) - 1;
+}
+
+void AddEdge(Graph& g, int32_t u, int32_t v) {
+  if (u == v) return;
+  auto& s = g.succs[u];
+  if (std::find(s.begin(), s.end(), v) == s.end()) {
+    s.push_back(v);
+    g.preds[v].push_back(u);
+  }
+}
+
+std::vector<int32_t> TopoOrder(const Graph& g) {
+  size_t n = g.base.size();
+  std::vector<int32_t> indeg(n), order;
+  order.reserve(n);
+  std::vector<int32_t> q;  // FIFO via index
+  for (size_t v = 0; v < n; ++v) {
+    indeg[v] = static_cast<int32_t>(g.preds[v].size());
+    if (indeg[v] == 0) q.push_back(static_cast<int32_t>(v));
+  }
+  for (size_t head = 0; head < q.size(); ++head) {
+    int32_t v = q[head];
+    order.push_back(v);
+    for (int32_t w : g.succs[v])
+      if (--indeg[w] == 0) q.push_back(w);
+  }
+  return order;
+}
+
+void Reachable(const Graph& g, int32_t root,
+               const std::vector<std::vector<int32_t>>& adj,
+               std::vector<char>* seen) {
+  std::vector<int32_t> stack{root};
+  (*seen)[root] = 1;
+  while (!stack.empty()) {
+    int32_t u = stack.back();
+    stack.pop_back();
+    for (int32_t w : adj[u])
+      if (!(*seen)[w]) {
+        (*seen)[w] = 1;
+        stack.push_back(w);
+      }
+  }
+}
+
+void TagSpan(Graph& g, int32_t start, int32_t end) {
+  size_t n = g.base.size();
+  std::vector<char> fwd(n, 0), bwd(n, 0);
+  Reachable(g, start, g.succs, &fwd);
+  Reachable(g, end, g.preds, &bwd);
+  for (size_t v = 0; v < n; ++v)
+    if (fwd[v] && bwd[v]) ++g.spanning[v];
+}
+
+std::vector<int32_t> AddFirstRead(Graph& g, const int8_t* read, int32_t n) {
+  std::vector<int32_t> path;
+  path.reserve(n);
+  int32_t prev = -1;
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t v = AddVertex(g, read[i]);
+    if (prev >= 0) AddEdge(g, prev, v);
+    path.push_back(v);
+    prev = v;
+  }
+  ++g.n_reads;
+  TagSpan(g, path.front(), path.back());
+  return path;
+}
+
+// LOCAL alignment of `read` against the DAG (PoaGraph.try_add_read).
+Plan TryAddRead(const Graph& g, std::vector<int8_t> read, bool rc) {
+  Plan p;
+  p.rc = rc;
+  int32_t I = static_cast<int32_t>(read.size());
+  size_t n = g.base.size();
+  int32_t w = I + 1;
+  size_t W = static_cast<size_t>(w);  // size_t stride: V*(I+1) can pass 2^31
+  p.cols.assign(n * W, 0.0f);
+  p.mpred.assign(n * W, -1);
+  p.dpred.assign(n * W, -1);
+  std::vector<float> best_m(w), best_d(w);
+  static const std::vector<int32_t> kNoPred{-1};
+
+  for (int32_t v : TopoOrder(g)) {
+    int8_t vb = g.base[v];
+    std::fill(best_m.begin(), best_m.end(), kNegInf);
+    std::fill(best_d.begin(), best_d.end(), kNegInf);
+    int32_t* bm = &p.mpred[v * W];
+    int32_t* bd = &p.dpred[v * W];
+    const auto& plist = g.preds[v].empty() ? kNoPred : g.preds[v];
+    for (int32_t pr : plist) {
+      const float* pc = pr < 0 ? nullptr : &p.cols[pr * W];
+      for (int32_t i = 1; i < w; ++i) {
+        float sub = read[i - 1] == vb ? kMatch : kMismatch;
+        float m = (pc ? pc[i - 1] : 0.0f) + sub;
+        if (m > best_m[i]) {
+          best_m[i] = m;
+          bm[i] = pr;
+        }
+      }
+      for (int32_t i = 0; i < w; ++i) {
+        float d = (pc ? pc[i] : 0.0f) + kDelete;
+        if (d > best_d[i]) {
+          best_d[i] = d;
+          bd[i] = pr;
+        }
+      }
+    }
+    float* col = &p.cols[v * W];
+    float run = kNegInf;
+    for (int32_t i = 0; i < w; ++i) {
+      float b = std::max(0.0f, std::max(best_m[i], best_d[i]));
+      run = std::max(b, run + kInsert);
+      col[i] = run;
+    }
+  }
+  // best local end: first strict max in (vertex, row) flat order
+  for (size_t f = 0; f < p.cols.size(); ++f)
+    if (p.cols[f] > p.score) {
+      p.score = p.cols[f];
+      p.best_vertex = static_cast<int32_t>(f / W);
+      p.best_row = static_cast<int32_t>(f % W);
+    }
+  p.read = std::move(read);
+  return p;
+}
+
+// Thread the read along the traceback (PoaGraph.commit_add).
+std::vector<int32_t> CommitAdd(Graph& g, const Plan& plan) {
+  const std::vector<int8_t>& read = plan.read;
+  int32_t I = static_cast<int32_t>(read.size());
+  size_t w = static_cast<size_t>(I) + 1;  // size_t stride (see TryAddRead)
+  std::vector<int32_t> path(I, -1);
+
+  auto new_chain_vertex = [&](int32_t i, int32_t fork) {
+    int32_t nv = AddVertex(g, read[i - 1]);
+    if (fork >= 0) AddEdge(g, nv, fork);
+    path[i - 1] = nv;
+    return nv;
+  };
+
+  int32_t fork = -1;
+  int32_t i = I;
+  while (i > plan.best_row) {
+    fork = new_chain_vertex(i, fork);
+    --i;
+  }
+
+  int32_t v = plan.best_vertex;
+  int32_t prev_visited = -1;
+  while (v >= 0 && i >= 0) {
+    float cell = plan.cols[v * w + i];
+    int8_t vb = g.base[v];
+    int32_t mp = plan.mpred[v * w + i];
+    int32_t dp = plan.dpred[v * w + i];
+    float m_val = kNegInf, e_val = kNegInf;
+    if (i > 0) {
+      float sub = read[i - 1] == vb ? kMatch : kMismatch;
+      m_val = (mp >= 0 ? plan.cols[mp * w + i - 1] : 0.0f) + sub;
+      e_val = plan.cols[v * w + i - 1] + kInsert;
+    }
+    float d_val = (dp >= 0 ? plan.cols[dp * w + i] : 0.0f) + kDelete;
+
+    if (i > 0 && cell == m_val) {
+      if (read[i - 1] == vb) {
+        g.have_scores = false;
+        ++g.nreads[v];
+        if (fork >= 0) {
+          AddEdge(g, v, fork);
+          fork = -1;
+        }
+        path[i - 1] = v;
+      } else {
+        if (fork < 0) fork = prev_visited;
+        fork = new_chain_vertex(i, fork);
+      }
+      --i;
+      prev_visited = v;
+      v = mp;
+    } else if (cell == d_val && dp >= 0) {
+      if (fork < 0) fork = prev_visited;
+      prev_visited = v;
+      v = dp;
+    } else if (i > 0 && cell == e_val) {
+      if (fork < 0) fork = prev_visited;
+      fork = new_chain_vertex(i, fork);
+      --i;
+    } else {
+      break;  // StartMove: alignment starts here
+    }
+  }
+
+  if (i > 0 && fork < 0) fork = prev_visited;
+  while (i > 0) {
+    fork = new_chain_vertex(i, fork);
+    --i;
+  }
+
+  ++g.n_reads;
+  TagSpan(g, path.front(), plan.best_vertex);
+  return path;
+}
+
+std::vector<int32_t> ConsensusPath(Graph& g, int32_t min_cov) {
+  size_t n = g.base.size();
+  g.score.assign(n, 0.0);
+  g.have_scores = true;
+  std::vector<double> reach(n, 0.0);
+  std::vector<int32_t> bprev(n, -1);
+  int32_t best_v = -1;
+  double best_score = -1e300;
+  for (int32_t v : TopoOrder(g)) {
+    double sc = 2.0 * g.nreads[v] -
+                std::max<int32_t>(g.spanning[v], min_cov) - 1e-4;
+    g.score[v] = sc;
+    double r = sc;
+    int32_t bp = -1;
+    for (int32_t pr : g.preds[v]) {
+      double c = sc + reach[pr];
+      if (c > r) {
+        r = c;
+        bp = pr;
+      }
+    }
+    reach[v] = r;
+    bprev[v] = bp;
+    if (r > best_score || (r == best_score && v < best_v)) {
+      best_score = r;
+      best_v = v;
+    }
+  }
+  std::vector<int32_t> path;
+  for (int32_t v = best_v; v >= 0; v = bprev[v]) path.push_back(v);
+  std::reverse(path.begin(), path.end());
+  return path;
+}
+
+}  // namespace poa
+
+extern "C" {
+
+void* pbccs_poa_new() { return new poa::Graph(); }
+void pbccs_poa_free(void* h) { delete static_cast<poa::Graph*>(h); }
+
+// Add a read in its better orientation if the LOCAL alignment score clears
+// min_score (SparsePoa.orient_and_add_read).  Writes the per-base vertex
+// path (oriented read order) and whether the reverse complement was used.
+// Returns 1 if added, 0 if rejected.
+int32_t pbccs_poa_orient_add(void* h, const int8_t* read, int32_t n,
+                             float min_score, int32_t* out_path,
+                             uint8_t* out_rc) {
+  auto* g = static_cast<poa::Graph*>(h);
+  if (n <= 0) return 0;
+  if (g->n_reads == 0) {
+    auto path = poa::AddFirstRead(*g, read, n);
+    std::memcpy(out_path, path.data(), n * sizeof(int32_t));
+    *out_rc = 0;
+    return 1;
+  }
+  std::vector<int8_t> fwd(read, read + n), rev(n);
+  for (int32_t i = 0; i < n; ++i) {
+    int8_t b = read[n - 1 - i];
+    rev[i] = b < 4 ? static_cast<int8_t>(3 - b) : b;
+  }
+  poa::Plan pf = poa::TryAddRead(*g, std::move(fwd), false);
+  poa::Plan pr = poa::TryAddRead(*g, std::move(rev), true);
+  poa::Plan& plan = pf.score >= pr.score ? pf : pr;
+  if (plan.score < min_score) return 0;
+  auto path = poa::CommitAdd(*g, plan);
+  std::memcpy(out_path, path.data(), n * sizeof(int32_t));
+  *out_rc = plan.rc ? 1 : 0;
+  return 1;
+}
+
+// Consensus path vertex ids; returns length (or -needed if cap too small).
+int32_t pbccs_poa_consensus(void* h, int32_t min_cov, int32_t* out_vs,
+                            int32_t cap) {
+  auto* g = static_cast<poa::Graph*>(h);
+  auto path = poa::ConsensusPath(*g, min_cov);
+  int32_t m = static_cast<int32_t>(path.size());
+  if (m > cap) return -m;
+  std::memcpy(out_vs, path.data(), m * sizeof(int32_t));
+  return m;
+}
+
+int32_t pbccs_poa_vertex_count(void* h) {
+  return static_cast<int32_t>(static_cast<poa::Graph*>(h)->base.size());
+}
+
+// Per-vertex state snapshot; score is valid only after a consensus call
+// on the current topology (returns 0 scores otherwise).
+int32_t pbccs_poa_export(void* h, int8_t* base, int32_t* nreads,
+                         int32_t* spanning, double* score) {
+  auto* g = static_cast<poa::Graph*>(h);
+  int32_t n = static_cast<int32_t>(g->base.size());
+  std::memcpy(base, g->base.data(), n);
+  std::memcpy(nreads, g->nreads.data(), n * sizeof(int32_t));
+  std::memcpy(spanning, g->spanning.data(), n * sizeof(int32_t));
+  for (int32_t v = 0; v < n; ++v)
+    score[v] = g->have_scores ? g->score[v] : 0.0;
+  return g->have_scores ? n : -n;
+}
+
+int32_t pbccs_poa_edge_count(void* h) {
+  auto* g = static_cast<poa::Graph*>(h);
+  size_t e = 0;
+  for (auto& s : g->succs) e += s.size();
+  return static_cast<int32_t>(e);
+}
+
+void pbccs_poa_edges(void* h, int32_t* u, int32_t* v) {
+  auto* g = static_cast<poa::Graph*>(h);
+  size_t k = 0;
+  for (size_t a = 0; a < g->succs.size(); ++a)
+    for (int32_t b : g->succs[a]) {
+      u[k] = static_cast<int32_t>(a);
+      v[k] = b;
+      ++k;
+    }
 }
 
 }  // extern "C"

@@ -57,6 +57,28 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.pbccs_chain_seeds.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+    lib.pbccs_poa_new.restype = ctypes.c_void_p
+    lib.pbccs_poa_new.argtypes = []
+    lib.pbccs_poa_free.restype = None
+    lib.pbccs_poa_free.argtypes = [ctypes.c_void_p]
+    lib.pbccs_poa_orient_add.restype = ctypes.c_int32
+    lib.pbccs_poa_orient_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_float,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.pbccs_poa_consensus.restype = ctypes.c_int32
+    lib.pbccs_poa_consensus.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+    lib.pbccs_poa_vertex_count.restype = ctypes.c_int32
+    lib.pbccs_poa_vertex_count.argtypes = [ctypes.c_void_p]
+    lib.pbccs_poa_export.restype = ctypes.c_int32
+    lib.pbccs_poa_export.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p]
+    lib.pbccs_poa_edge_count.restype = ctypes.c_int32
+    lib.pbccs_poa_edge_count.argtypes = [ctypes.c_void_p]
+    lib.pbccs_poa_edges.restype = None
+    lib.pbccs_poa_edges.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -99,6 +121,98 @@ def bgzf_decompress(data: bytes, expected_size: int | None = None) -> Optional[b
         if n != -2 or expected_size is not None or cap > (1 << 31):
             return None            # -1 = corrupt input; give up immediately
         cap *= 4                   # -2 = under-capacity; grow and retry
+
+
+class NativePoa:
+    """Handle-based native POA engine (behavior-identical to
+    poa.graph.PoaGraph; see native/pbccs_native.cpp).  None-returning
+    factory `native_poa()` keeps the pure-Python fallback silent."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._h = lib.pbccs_poa_new()
+        self.n_reads = 0
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.pbccs_poa_free(h)
+
+    def orient_add(self, read: np.ndarray, min_score: float = 0.0):
+        """(path, reverse_complemented) or None when rejected."""
+        r = np.ascontiguousarray(read, np.int8)
+        n = len(r)
+        path = np.zeros(n, np.int32)
+        rc = ctypes.c_uint8(0)
+        added = self._lib.pbccs_poa_orient_add(
+            self._h, r.ctypes.data_as(ctypes.c_void_p), n,
+            ctypes.c_float(min_score),
+            path.ctypes.data_as(ctypes.c_void_p), ctypes.byref(rc))
+        if not added:
+            return None
+        self.n_reads += 1
+        return path.tolist(), bool(rc.value)
+
+    def consensus_path(self, min_coverage: int) -> list[int]:
+        cap = max(self._lib.pbccs_poa_vertex_count(self._h), 1)
+        out = np.zeros(cap, np.int32)
+        m = self._lib.pbccs_poa_consensus(
+            self._h, min_coverage, out.ctypes.data_as(ctypes.c_void_p), cap)
+        assert m >= 0
+        return out[:m].tolist()
+
+    def bases(self) -> np.ndarray:
+        """(V,) int8 per-vertex bases (no full graph export)."""
+        n = self._lib.pbccs_poa_vertex_count(self._h)
+        base = np.zeros(n, np.int8)
+        nreads = np.zeros(n, np.int32)
+        spanning = np.zeros(n, np.int32)
+        score = np.zeros(n, np.float64)
+        self._lib.pbccs_poa_export(
+            self._h, base.ctypes.data_as(ctypes.c_void_p),
+            nreads.ctypes.data_as(ctypes.c_void_p),
+            spanning.ctypes.data_as(ctypes.c_void_p),
+            score.ctypes.data_as(ctypes.c_void_p))
+        return base
+
+    def export_graph(self):
+        """Read-only PoaGraph snapshot (for variant calling / GraphViz)."""
+        from pbccs_tpu.poa.graph import PoaGraph
+
+        n = self._lib.pbccs_poa_vertex_count(self._h)
+        base = np.zeros(n, np.int8)
+        nreads = np.zeros(n, np.int32)
+        spanning = np.zeros(n, np.int32)
+        score = np.zeros(n, np.float64)
+        have = self._lib.pbccs_poa_export(
+            self._h, base.ctypes.data_as(ctypes.c_void_p),
+            nreads.ctypes.data_as(ctypes.c_void_p),
+            spanning.ctypes.data_as(ctypes.c_void_p),
+            score.ctypes.data_as(ctypes.c_void_p)) >= 0
+        e = self._lib.pbccs_poa_edge_count(self._h)
+        eu = np.zeros(e, np.int32)
+        ev = np.zeros(e, np.int32)
+        self._lib.pbccs_poa_edges(self._h,
+                                  eu.ctypes.data_as(ctypes.c_void_p),
+                                  ev.ctypes.data_as(ctypes.c_void_p))
+        g = PoaGraph()
+        g.base = base.tolist()
+        g.nreads = nreads.tolist()
+        g.spanning = spanning.tolist()
+        g.preds = [[] for _ in range(n)]
+        g.succs = [[] for _ in range(n)]
+        for u, v in zip(eu.tolist(), ev.tolist()):
+            g.succs[u].append(v)
+            g.preds[v].append(u)
+        g.n_reads = self.n_reads
+        if have:
+            g.vertex_score = score.astype(np.float32)
+        return g
+
+
+def native_poa() -> Optional[NativePoa]:
+    lib = _load()
+    return NativePoa(lib) if lib is not None else None
 
 
 def chain_seeds(seeds: np.ndarray, k: int,

@@ -1,6 +1,12 @@
 """SparsePoa equivalent: orientation handling + consensus + per-read extents.
 
 Parity: reference src/SparsePoa.cpp:96-199 / include/pacbio/ccs/SparsePoa.h.
+
+The alignment/threading engine has two behavior-identical backends: the
+native C++ engine (native/pbccs_native.cpp, used when the library loads --
+the draft stage is the host-side bottleneck once polishing runs on the
+accelerator) and the pure-Python PoaGraph (the reference implementation and
+fallback; PBCCS_NATIVE=0 forces it).
 """
 
 from __future__ import annotations
@@ -9,6 +15,7 @@ import dataclasses
 
 import numpy as np
 
+from pbccs_tpu import native
 from pbccs_tpu.models.arrow.params import revcomp
 from pbccs_tpu.poa.graph import PoaGraph
 
@@ -24,25 +31,49 @@ class PoaAlignmentSummary:
 
 class SparsePoa:
     def __init__(self):
-        self.graph = PoaGraph()
+        self._native = native.native_poa()
+        self._graph = PoaGraph() if self._native is None else None
+        self._snapshot: PoaGraph | None = None
         self.read_paths: list[list[int]] = []
         self.reverse_complemented: list[bool] = []
+
+    @property
+    def graph(self) -> PoaGraph:
+        """The POA graph.  On the Python backend this is the live graph; on
+        the native backend it is a READ-ONLY snapshot (bases/edges/counts/
+        consensus scores) cached until the next added read -- mutations made
+        to the snapshot are discarded."""
+        if self._native is not None:
+            if self._snapshot is None:
+                self._snapshot = self._native.export_graph()
+            return self._snapshot
+        return self._graph
 
     def orient_and_add_read(self, read: np.ndarray, min_score_to_add: float = 0.0) -> int:
         """Try both orientations, commit the better one if it clears the
         score bar; returns the read key or -1
         (reference SparsePoa.cpp:96-137)."""
-        if self.graph.n_reads == 0:
-            path = self.graph.add_first_read(read)
+        if self._native is not None:
+            res = self._native.orient_add(read, min_score_to_add)
+            self._snapshot = None
+            if res is None:
+                return -1
+            path, rc = res
+            self.read_paths.append(path)
+            self.reverse_complemented.append(rc)
+            return len(self.read_paths) - 1
+
+        if self._graph.n_reads == 0:
+            path = self._graph.add_first_read(read)
             self.read_paths.append(path)
             self.reverse_complemented.append(False)
             return 0
-        fwd = self.graph.try_add_read(read, False)
-        rev = self.graph.try_add_read(revcomp(read), True)
+        fwd = self._graph.try_add_read(read, False)
+        rev = self._graph.try_add_read(revcomp(read), True)
         plan = fwd if fwd.score >= rev.score else rev
         if plan.score < min_score_to_add:
             return -1
-        path = self.graph.commit_add(plan)
+        path = self._graph.commit_add(plan)
         self.read_paths.append(path)
         self.reverse_complemented.append(plan.reverse_complemented)
         return len(self.read_paths) - 1
@@ -50,9 +81,15 @@ class SparsePoa:
     def find_consensus(self, min_coverage: int):
         """Returns (consensus codes, per-read PoaAlignmentSummary list)
         (reference SparsePoa.cpp:139-199)."""
-        path = self.graph.consensus_path(min_coverage)
+        if self._native is not None:
+            path = self._native.consensus_path(min_coverage)
+            self._snapshot = None  # consensus (re)computes vertex scores
+            css = self._native.bases()[np.asarray(path, np.int64)] \
+                if path else np.zeros(0, np.int8)
+        else:
+            path = self._graph.consensus_path(min_coverage)
+            css = np.asarray([self._graph.base[v] for v in path], np.int8)
         self.last_consensus_path = path
-        css = np.asarray([self.graph.base[v] for v in path], np.int8)
         css_position = {v: i for i, v in enumerate(path)}
 
         summaries = []

@@ -63,3 +63,43 @@ def test_chain_seeds_real_sequences(rng):
     assert len(chain) > 10
     assert (np.diff(chain[:, 0]) > 0).all()
     assert (np.diff(chain[:, 1]) > 0).all()
+
+
+def test_native_poa_matches_python(rng):
+    """The native POA engine and the pure-Python PoaGraph make identical
+    add/orientation decisions and produce identical consensus + extents
+    (the native engine is documented behavior-identical)."""
+    import pbccs_tpu.native as nat
+    from pbccs_tpu.poa.graph import PoaGraph
+    from pbccs_tpu.poa.sparse import SparsePoa
+    from pbccs_tpu.models.arrow.params import revcomp
+    from pbccs_tpu.simulate import (
+        make_transition_track, random_snr, random_template, sample_read)
+
+    if not nat.available():
+        pytest.skip("native library unavailable")
+
+    for trial in range(10):
+        tpl = random_template(rng, int(rng.integers(40, 180)))
+        trans = make_transition_track(tpl, random_snr(rng))
+        reads = [sample_read(rng, tpl, trans)
+                 for _ in range(int(rng.integers(2, 7)))]
+        reads = [revcomp(r) if rng.random() < 0.4 else r for r in reads]
+
+        pn = SparsePoa()
+        assert pn._native is not None
+        pp = SparsePoa.__new__(SparsePoa)
+        pp._native = None
+        pp._graph = PoaGraph()
+        pp._snapshot = None
+        pp.read_paths = []
+        pp.reverse_complemented = []
+
+        assert [pn.orient_and_add_read(r) for r in reads] == \
+            [pp.orient_and_add_read(r) for r in reads], trial
+        cn, sn = pn.find_consensus(2)
+        cp, sp = pp.find_consensus(2)
+        np.testing.assert_array_equal(cn, cp)
+        assert pn.last_consensus_path == pp.last_consensus_path
+        for a, b in zip(sn, sp):
+            assert a == b, trial
