@@ -22,7 +22,6 @@ polynomial evaluation over an int8 base tensor, jit/vmap friendly.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp

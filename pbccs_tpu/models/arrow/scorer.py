@@ -38,7 +38,6 @@ from pbccs_tpu.ops.fwdbwd import (
 from pbccs_tpu.ops.fwdbwd_pallas import _MAX_SHIFT as _MAX_BAND_SHIFT, fills_use_pallas
 from pbccs_tpu.utils import next_pow2 as _next_pow2
 from pbccs_tpu.ops.mutation_score import (
-    DEL,
     INS,
     SUB,
     MutationPatch,

@@ -23,7 +23,6 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.params import revcomp
 from pbccs_tpu.models.quiver.params import QuiverConfig
 from pbccs_tpu.models.quiver.recursor import (
-    QuiverFeatureArrays,
     feature_arrays,
     quiver_backward,
     quiver_forward,

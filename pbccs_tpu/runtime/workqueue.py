@@ -14,7 +14,6 @@ inside device calls and zlib).
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor

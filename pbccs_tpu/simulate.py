@@ -12,7 +12,6 @@ from __future__ import annotations
 import numpy as np
 
 from pbccs_tpu.models.arrow.params import (
-    BASES,
     TRANS_BRANCH,
     TRANS_DARK,
     TRANS_MATCH,

@@ -35,3 +35,16 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables and tracing caches after each test module.
+
+    The full suite compiles hundreds of distinct program shapes; letting
+    them accumulate in one process degrades dispatch and tracing until the
+    heavy tail tests crawl (observed: a test that takes 70 s alone taking
+    5-10x longer at the end of the suite).  The persistent compilation
+    cache makes any cross-module recompiles cheap disk loads."""
+    yield
+    jax.clear_caches()
