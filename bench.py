@@ -141,12 +141,9 @@ def main() -> None:
 
     import jax
 
-    # persistent compilation cache: the polish programs take minutes to
-    # compile at large batch shapes; cached executables make warmup cheap
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     platform = jax.devices()[0].platform
     print(f"bench: platform={platform} Z={n_zmws} L={tpl_len} P={n_passes}",

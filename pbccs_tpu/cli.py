@@ -184,6 +184,10 @@ def _chunks_from_files(files, whitelist: Whitelist, args, log,
 def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     log = Logger.default(Logger(
         stream=open(args.logFile, "w") if args.logFile else sys.stderr,
         level=LogLevel.from_string(args.logLevel)))

@@ -1,0 +1,30 @@
+"""Persistent JAX compilation-cache setup shared by the CLI and bench.
+
+The polish programs take minutes to compile at batch shapes; cached
+executables make reruns start fast.  Respects a user-provided
+JAX_COMPILATION_CACHE_DIR (or an already-set config value) and falls back
+to the repo checkout's .jax_cache when writable, else a per-user cache
+directory."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache() -> str:
+    import jax
+
+    configured = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+        jax.config.jax_compilation_cache_dir
+    if configured:
+        cache_dir = configured
+    else:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cache_dir = os.path.join(repo, ".jax_cache")
+        if not os.access(os.path.dirname(cache_dir), os.W_OK):
+            cache_dir = os.path.join(
+                os.path.expanduser("~"), ".cache", "pbccs_tpu", "jax")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
