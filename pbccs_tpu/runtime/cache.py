@@ -22,9 +22,19 @@ def enable_compilation_cache() -> str:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         cache_dir = os.path.join(repo, ".jax_cache")
-        if not os.access(os.path.dirname(cache_dir), os.W_OK):
+        # use the checkout-local cache only when running from a source tree
+        # (a pip install would land this in site-packages, where executables
+        # are lost on upgrade) and it is actually writable
+        in_checkout = os.path.isdir(os.path.join(repo, ".git"))
+        writable = os.access(cache_dir if os.path.isdir(cache_dir) else repo,
+                             os.W_OK)
+        if not (in_checkout and writable):
             cache_dir = os.path.join(
                 os.path.expanduser("~"), ".cache", "pbccs_tpu", "jax")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # respect a user-provided min-compile-time; default to caching anything
+    # that took >= 1 s to compile
+    if os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS") is None \
+            and jax.config.jax_persistent_cache_min_compile_time_secs <= 0:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir

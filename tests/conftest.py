@@ -23,10 +23,9 @@ jax.config.update("jax_platforms", "cpu")
 
 # persistent compilation cache: the batched polish programs take minutes to
 # compile on CPU; cached executables make repeat test runs fast
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from pbccs_tpu.runtime.cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 import numpy as np
 import pytest

@@ -126,3 +126,28 @@ def test_partial_refill_matches_full(rng):
         np.testing.assert_array_equal(pol_full.tpls[z], pol_part.tpls[z])
         np.testing.assert_array_equal(qv_full[z], qv_part[z])
         assert res_full[z].converged == res_part[z].converged
+
+
+def test_tiny_window_fallback_matches_per_zmw(rng):
+    """Reads whose template window is shorter than MIN_FAST_EDGE_WLEN score
+    boundary mutations by full refill (the fallback pair path); decisions
+    must still match the per-ZMW scorer."""
+    from pbccs_tpu.parallel.batch import MIN_FAST_EDGE_WLEN
+
+    tpl, reads, strands, snr = simulate_zmw(rng, 60, 5)
+    tstarts = [0] * len(reads)
+    tends = [len(tpl)] * len(reads)
+    # clip one read to a tiny window at the template start
+    w = MIN_FAST_EDGE_WLEN - 2
+    reads = list(reads)
+    reads[1] = reads[1][:w]
+    tends[1] = w
+
+    task = ZmwTask("tiny/0", tpl, snr, reads, strands, tstarts, tends)
+    pol = BatchPolisher([task])
+    sc = ArrowMultiReadScorer(tpl, snr, reads, strands, tstarts, tends)
+
+    muts = mutlib.enumerate_unique(tpl)
+    batch_scores = pol.score_mutations([muts])[0]
+    serial_scores = sc.score_mutations(muts)
+    np.testing.assert_allclose(batch_scores, serial_scores, atol=2e-3)
