@@ -10,10 +10,12 @@ Prints ONE JSON line:
   {"metric": "polish_zmws_per_sec", "value": N, "unit": "ZMW/s",
    "vs_baseline": N}
 
-vs_baseline compares against the recorded single-socket CPU throughput of the
-same workload (BASELINE_LOCAL.json, written by `python bench.py
---record-cpu-baseline`), per BASELINE.md: the reference publishes no numbers,
-so the baseline is measured on a faithful reimplementation.
+vs_baseline compares against the STRONGER recorded single-socket CPU number
+in BASELINE_LOCAL.json: this framework on CPU (`python bench.py
+--record-cpu-baseline`) or the reference's own C++ compiled -O3 on the
+identical workload (three-step recipe in native/refbench/README.md; its
+result is recorded by hand in BASELINE_LOCAL.json), per BASELINE.md.
+vs_reference_cpp is reported separately when recorded.
 
 Usage:
   python bench.py                      # bench on the default jax platform
@@ -153,23 +155,38 @@ def main() -> None:
     print(f"bench: {json.dumps(stats)}", file=sys.stderr)
 
     if record_baseline:
+        # merge into the existing record: the reference C++ numbers in it
+        # (recorded manually per native/refbench/README.md) must survive a
+        # framework-CPU re-record
+        rec = {}
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                rec = json.load(f)
+        rec.update({"cpu_zmws_per_sec": stats["zmws_per_sec"],
+                    "platform": platform,
+                    "cpu_batch": batch_size,
+                    "config": {"n_zmws": n_zmws, "tpl_len": tpl_len,
+                               "n_passes": n_passes,
+                               "n_corruptions": n_corr}})
         with open(BASELINE_FILE, "w") as f:
-            json.dump({"cpu_zmws_per_sec": stats["zmws_per_sec"],
-                       "platform": platform,
-                       "cpu_batch": batch_size,
-                       "config": {"n_zmws": n_zmws, "tpl_len": tpl_len,
-                                  "n_passes": n_passes,
-                                  "n_corruptions": n_corr}}, f, indent=2)
+            json.dump(rec, f, indent=2)
         print(f"wrote {BASELINE_FILE}", file=sys.stderr)
 
-    baseline = None
+    baseline = ref_cpp = None
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             rec = json.load(f)
         this_config = {"n_zmws": n_zmws, "tpl_len": tpl_len,
                        "n_passes": n_passes, "n_corruptions": n_corr}
         if rec.get("config") == this_config:
-            baseline = rec.get("cpu_zmws_per_sec")
+            # vs_baseline is measured against the STRONGER of (a) this
+            # framework on CPU and (b) the reference's own C++ compiled -O3
+            # on the identical workload (native/refbench/) -- the honest
+            # comparison BASELINE.md asks for
+            ref_cpp = rec.get("reference_cpp_zmws_per_sec")
+            candidates = [v for v in (rec.get("cpu_zmws_per_sec"), ref_cpp)
+                          if v]
+            baseline = max(candidates) if candidates else None
         else:
             print(f"bench: recorded CPU baseline config {rec.get('config')} "
                   f"does not match workload {this_config}; re-record with "
@@ -177,12 +194,15 @@ def main() -> None:
                   file=sys.stderr)
 
     vs_baseline = (stats["zmws_per_sec"] / baseline) if baseline else 1.0
-    print(json.dumps({
+    line = {
         "metric": "polish_zmws_per_sec",
         "value": round(stats["zmws_per_sec"], 4),
         "unit": "ZMW/s",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }
+    if ref_cpp:
+        line["vs_reference_cpp"] = round(stats["zmws_per_sec"] / ref_cpp, 4)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Dump the bench.py workload in the text format refbench.cpp consumes.
+
+Reproduces bench.build_tasks with the same seed, so the reference C++
+baseline measures the identical 128 ZMWs the TPU bench polishes (first
+draw; bench.py's timed repeats draw fresh but statistically identical
+workloads from the same stream).
+
+Usage: python native/refbench/dump_workload.py [OUT.txt]
+Env knobs mirror bench.py: BENCH_ZMWS/BENCH_TPL_LEN/BENCH_PASSES/
+BENCH_CORRUPTIONS, plus REFBENCH_ITERS (default 10, = bench.py's
+RefineOptions.max_iterations) and REFBENCH_MIN_ZSCORE (default -5, the
+reference CLI default).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main() -> None:
+    import numpy as np
+
+    from bench import build_tasks
+    from pbccs_tpu.models.arrow.params import decode_bases
+
+    n_zmws = int(os.environ.get("BENCH_ZMWS", 128))
+    tpl_len = int(os.environ.get("BENCH_TPL_LEN", 300))
+    n_passes = int(os.environ.get("BENCH_PASSES", 8))
+    n_corr = int(os.environ.get("BENCH_CORRUPTIONS", 2))
+    iters = int(os.environ.get("REFBENCH_ITERS", 10))
+    min_z = float(os.environ.get("REFBENCH_MIN_ZSCORE", -5.0))
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "workload.txt"
+
+    rng = np.random.default_rng(20260729)
+    tasks, _truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corr)
+
+    with open(out_path, "w") as f:
+        f.write(f"CONFIG {n_zmws} {tpl_len} {n_passes} {iters} {min_z}\n")
+        for t in tasks:
+            f.write(f"ZMW {t.id.replace(' ', '_')} "
+                    f"{t.snr[0]} {t.snr[1]} {t.snr[2]} {t.snr[3]} "
+                    f"{len(t.reads)}\n")
+            f.write(f"DRAFT {decode_bases(t.tpl)}\n")
+            for read, strand in zip(t.reads, t.strands):
+                f.write(f"READ {strand} {decode_bases(read)}\n")
+    print(f"wrote {out_path}: {n_zmws} ZMWs x L{tpl_len} x P{n_passes}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+// Empty: the only symbol Mutation.cpp pulls from here is boost::str, which
+// our format.hpp stub provides.
+#pragma once
+#include <boost/format.hpp>

@@ -1,0 +1,4 @@
+// Minimal stand-in for BOOST_FOREACH: C++11 range-for covers every use in
+// the ConsensusCore Arrow compile set (no comma-typed loop variables).
+#pragma once
+#define BOOST_FOREACH(decl, col) for (decl : col)

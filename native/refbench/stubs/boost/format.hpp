@@ -1,0 +1,31 @@
+// Minimal boost::format: enough for ConsensusCore's diagnostic strings
+// (exception text and ToString dumps, none on the hot path). Does not
+// implement printf-style substitution — arguments are appended after the
+// format string, which preserves the information content.
+#pragma once
+#include <ostream>
+#include <sstream>
+#include <string>
+
+namespace boost {
+class format {
+ public:
+  explicit format(const std::string& fmt) : fmt_(fmt) {}
+  template <typename T>
+  format& operator%(const T& v) {
+    args_ << ' ' << v;
+    return *this;
+  }
+  std::string str() const { return fmt_ + args_.str(); }
+  operator std::string() const { return str(); }
+
+ private:
+  std::string fmt_;
+  std::ostringstream args_;
+};
+
+inline std::string str(const format& f) { return f.str(); }
+inline std::ostream& operator<<(std::ostream& os, const format& f) {
+  return os << f.str();
+}
+}  // namespace boost

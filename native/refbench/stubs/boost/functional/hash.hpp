@@ -1,0 +1,6 @@
+#pragma once
+#include <functional>
+namespace boost {
+template <typename T>
+struct hash : std::hash<T> {};
+}  // namespace boost

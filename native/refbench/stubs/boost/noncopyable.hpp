@@ -1,0 +1,12 @@
+#pragma once
+namespace boost {
+class noncopyable {
+ protected:
+  noncopyable() = default;
+  ~noncopyable() = default;
+
+ public:
+  noncopyable(const noncopyable&) = delete;
+  noncopyable& operator=(const noncopyable&) = delete;
+};
+}  // namespace boost

@@ -1,0 +1,31 @@
+// Minimal ublas::matrix: dense row-major storage with (i,j) access — the
+// only surface the reference compile set touches (PairwiseAlignment.cpp's
+// NW score matrix; ContextParameterProvider's include is vestigial).
+#pragma once
+#include <cstddef>
+#include <vector>
+
+namespace boost {
+namespace numeric {
+namespace ublas {
+
+template <typename T>
+class matrix {
+ public:
+  matrix(std::size_t rows, std::size_t cols)
+      : rows_(rows), cols_(cols), data_(rows * cols) {}
+  T& operator()(std::size_t i, std::size_t j) { return data_[i * cols_ + j]; }
+  const T& operator()(std::size_t i, std::size_t j) const {
+    return data_[i * cols_ + j];
+  }
+  std::size_t size1() const { return rows_; }
+  std::size_t size2() const { return cols_; }
+
+ private:
+  std::size_t rows_, cols_;
+  std::vector<T> data_;
+};
+
+}  // namespace ublas
+}  // namespace numeric
+}  // namespace boost

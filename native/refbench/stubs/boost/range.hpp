@@ -1,0 +1,13 @@
+// Minimal Boost.Range surface: the primary iterator-metafunction templates
+// that ConsensusCore's Feature.hpp specializes.
+#pragma once
+namespace boost {
+template <typename T>
+struct range_const_iterator {
+  typedef typename T::const_iterator type;
+};
+template <typename T>
+struct range_mutable_iterator {
+  typedef typename T::iterator type;
+};
+}  // namespace boost

@@ -1,0 +1,11 @@
+// boost::as_array for plain C arrays: identity (range-for already treats a
+// C array as an N-element range, which matches Boost.Range array semantics).
+#pragma once
+#include <cstddef>
+
+namespace boost {
+template <typename T, std::size_t N>
+inline T (&as_array(T (&arr)[N]))[N] {
+  return arr;
+}
+}  // namespace boost

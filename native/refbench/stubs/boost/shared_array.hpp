@@ -1,0 +1,20 @@
+// Minimal boost::shared_array over std::shared_ptr<T[]> — only the surface
+// ConsensusCore's Feature<T> uses (ctor from new[], operator[], get()).
+#pragma once
+#include <cstddef>
+#include <memory>
+
+namespace boost {
+template <typename T>
+class shared_array {
+ public:
+  shared_array() = default;
+  explicit shared_array(T* p) : p_(p, std::default_delete<T[]>()) {}
+  T& operator[](std::ptrdiff_t i) const { return p_.get()[i]; }
+  T* get() const { return p_.get(); }
+  explicit operator bool() const { return static_cast<bool>(p_); }
+
+ private:
+  std::shared_ptr<T[]> p_;
+};
+}  // namespace boost

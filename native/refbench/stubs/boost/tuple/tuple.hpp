@@ -1,0 +1,15 @@
+// Minimal stand-in for boost/tuple/tuple.hpp backed by std::tuple.
+// Part of the no-Boost shim set that lets the reference ConsensusCore Arrow
+// sources compile unmodified for the honest CPU baseline (see ../../README.md).
+#pragma once
+#include <functional>
+#include <tuple>
+
+namespace boost {
+template <typename... Ts>
+using tuple = std::tuple<Ts...>;
+using std::get;
+using std::make_tuple;
+using std::ref;
+using std::tie;
+}  // namespace boost

@@ -1,0 +1,35 @@
+// No-op cpplog shim: ConsensusCore's LDEBUG/LTRACE… macros expand through
+// LOG_* to a sink that discards everything (the real cpplog needs
+// boost::thread). Logging off the hot path does not affect the benchmark.
+#pragma once
+#include <ostream>
+
+namespace cpplog {
+struct NullSink {
+  template <typename T>
+  NullSink& operator<<(const T&) {
+    return *this;
+  }
+  NullSink& operator<<(std::ostream& (*)(std::ostream&)) { return *this; }
+};
+struct BaseLogger {};
+struct StdErrLogger : BaseLogger {};
+struct FilteringLogger : BaseLogger {
+  template <typename... A>
+  explicit FilteringLogger(A&&...) {}
+};
+}  // namespace cpplog
+
+#define LL_TRACE 0
+#define LL_DEBUG 1
+#define LL_INFO 2
+#define LL_WARN 3
+#define LL_ERROR 4
+#define LL_FATAL 5
+
+#define LOG_TRACE(l) cpplog::NullSink()
+#define LOG_DEBUG(l) cpplog::NullSink()
+#define LOG_INFO(l) cpplog::NullSink()
+#define LOG_WARN(l) cpplog::NullSink()
+#define LOG_ERROR(l) cpplog::NullSink()
+#define LOG_FATAL(l) cpplog::NullSink()

@@ -1,0 +1,16 @@
+// Injected via -include: range-for over ConsensusCore::Feature<T>.
+// The real BOOST_FOREACH finds the reference's range_begin/range_end
+// extension points; our range-for shim needs ADL-visible begin/end instead.
+#pragma once
+namespace ConsensusCore {
+template <typename T>
+class Feature;
+template <typename T>
+inline const T* begin(const Feature<T>& f) {
+  return f.get();
+}
+template <typename T>
+inline const T* end(const Feature<T>& f) {
+  return f.get() + f.Length();
+}
+}  // namespace ConsensusCore
