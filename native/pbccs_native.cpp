@@ -237,13 +237,32 @@ struct Graph {
   bool have_scores = false;
 };
 
+// Per-vertex banded DP storage: vertex v's column holds rows [lo[v], hi[v])
+// at cols[off[v]..]; cells outside the band read as 0 = "a LOCAL alignment
+// may start here" (consistent with the fill's max(0, ...) floor), preds as
+// -1.  An unbanded plan is simply lo=0, hi=I+1 everywhere.
 struct Plan {
   float score = kNegInf;
   int32_t best_vertex = -1, best_row = 0;
   bool rc = false;
   std::vector<int8_t> read;           // oriented read
-  std::vector<float> cols;            // V * (I+1)
-  std::vector<int32_t> mpred, dpred;  // V * (I+1)
+  std::vector<int32_t> lo, hi;        // per-vertex DP-row band
+  std::vector<int64_t> off;           // per-vertex offset into banded arrays
+  std::vector<float> cols;            // sum of band widths
+  std::vector<int32_t> mpred, dpred;
+
+  float Cell(int32_t v, int32_t i) const {
+    return (i >= lo[v] && i < hi[v]) ? cols[off[v] + i - lo[v]] : 0.0f;
+  }
+  int32_t MPred(int32_t v, int32_t i) const {
+    return (i >= lo[v] && i < hi[v]) ? mpred[off[v] + i - lo[v]] : -1;
+  }
+  int32_t DPred(int32_t v, int32_t i) const {
+    return (i >= lo[v] && i < hi[v]) ? dpred[off[v] + i - lo[v]] : -1;
+  }
+  bool InBand(int32_t v, int32_t i) const {
+    return i >= lo[v] && i < hi[v];
+  }
 };
 
 int32_t AddVertex(Graph& g, int8_t b) {
@@ -323,59 +342,243 @@ std::vector<int32_t> AddFirstRead(Graph& g, const int8_t* read, int32_t n) {
   return path;
 }
 
+// ---- SDP-anchored banding (reference RangeFinder.cpp:72-167 semantics;
+// see pbccs_tpu/poa/banding.py for the full derivation notes). ----
+
+// Shared k-mer (cssPos, readPos) seeds via a sorted (hash, pos) table over
+// the css; homopolymer k-mers and k-mers occurring > kMaxOcc times in the
+// css are masked (reference HpHasher + FilterSeeds intent).
+void FindSeeds(const std::vector<int8_t>& css, const std::vector<int8_t>& read,
+               int32_t k, std::vector<int32_t>* sh, std::vector<int32_t>* sv) {
+  constexpr int32_t kMaxOcc = 64;
+  const int64_t mask = (int64_t(1) << (2 * k)) - 1;
+  auto hashes = [&](const std::vector<int8_t>& s) {
+    std::vector<int64_t> h(s.size() >= size_t(k) ? s.size() - k + 1 : 0, -1);
+    int64_t cur = 0;
+    int32_t valid = 0;
+    for (size_t i = 0; i < s.size(); ++i) {
+      if (s[i] < 0 || s[i] > 3) {
+        valid = 0;
+        cur = 0;
+      } else {
+        cur = ((cur << 2) | s[i]) & mask;
+        ++valid;
+      }
+      if (valid >= k && i + 1 >= size_t(k)) h[i + 1 - k] = cur;
+    }
+    return h;
+  };
+  std::vector<int64_t> hp(4);  // homopolymer hashes
+  for (int64_t b = 0; b < 4; ++b) {
+    int64_t v = 0;
+    for (int32_t j = 0; j < k; ++j) v = (v << 2) | b;
+    hp[b] = v;
+  }
+  auto h1 = hashes(css), h2 = hashes(read);
+  std::vector<std::pair<int64_t, int32_t>> table;
+  table.reserve(h1.size());
+  for (size_t i = 0; i < h1.size(); ++i)
+    if (h1[i] >= 0) table.emplace_back(h1[i], static_cast<int32_t>(i));
+  std::sort(table.begin(), table.end());
+  for (size_t j = 0; j < h2.size(); ++j) {
+    int64_t h = h2[j];
+    if (h < 0 || h == hp[0] || h == hp[1] || h == hp[2] || h == hp[3])
+      continue;
+    auto lo = std::lower_bound(table.begin(), table.end(),
+                               std::make_pair(h, INT32_MIN));
+    auto hi = std::upper_bound(table.begin(), table.end(),
+                               std::make_pair(h, INT32_MAX));
+    if (hi - lo > kMaxOcc) continue;
+    for (auto it = lo; it != hi; ++it) {
+      sh->push_back(it->second);
+      sv->push_back(static_cast<int32_t>(j));
+    }
+  }
+}
+
+// Longest strictly-increasing (cssPos, readPos) subsequence of the seeds:
+// the banding anchor chain, O(n log n) patience LIS.  Mirror of
+// pbccs_tpu.poa.banding.anchor_chain (see its docstring for why the scored
+// SDP chainer is not used on this path).
+void AnchorChain(std::vector<int32_t>* sh, std::vector<int32_t>* sv) {
+  const int32_t n = static_cast<int32_t>(sh->size());
+  if (n == 0) return;
+  std::vector<int32_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  // cssPos asc, readPos DESC so equal-cssPos seeds cannot chain together
+  std::stable_sort(idx.begin(), idx.end(), [&](int32_t a, int32_t b) {
+    if ((*sh)[a] != (*sh)[b]) return (*sh)[a] < (*sh)[b];
+    return (*sv)[a] > (*sv)[b];
+  });
+  std::vector<int32_t> tails_r, tails_i, parent(n, -1);
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t r = (*sv)[idx[i]];
+    auto it = std::lower_bound(tails_r.begin(), tails_r.end(), r);
+    size_t k = it - tails_r.begin();
+    parent[i] = k ? tails_i[k - 1] : -1;
+    if (it == tails_r.end()) {
+      tails_r.push_back(r);
+      tails_i.push_back(i);
+    } else {
+      *it = r;
+      tails_i[k] = i;
+    }
+  }
+  std::vector<int32_t> chain;
+  for (int32_t i = tails_i.back(); i >= 0; i = parent[i]) chain.push_back(i);
+  std::reverse(chain.begin(), chain.end());
+  std::vector<int32_t> ch(chain.size()), cv(chain.size());
+  for (size_t a = 0; a < chain.size(); ++a) {
+    ch[a] = (*sh)[idx[chain[a]]];
+    cv[a] = (*sv)[idx[chain[a]]];
+  }
+  sh->swap(ch);
+  sv->swap(cv);
+}
+
+// Per-vertex DP-row bands [lo, hi) from chained anchors css<->read:
+// direct ranges +-WIDTH at anchored consensus-path vertices, forward/
+// reverse closure over the DAG, hull of both, full-width fallback for
+// vertices both closures miss.  Returns empty (=> unbanded fill) when
+// fewer than 2 anchors chain.
+std::vector<int32_t> SdpBands(const Graph& g,
+                              const std::vector<int32_t>& topo,
+                              const std::vector<int32_t>& css_path,
+                              const std::vector<int32_t>& ch,
+                              const std::vector<int32_t>& cv,
+                              int32_t read_len) {
+  constexpr int32_t kWidth = 30;   // reference RangeFinder.cpp:15
+  const int32_t I = read_len;
+  const int32_t m = static_cast<int32_t>(ch.size());
+  if (m < 2) return {};
+
+  const size_t n = g.base.size();
+  constexpr int32_t kBig = INT32_MAX / 2;
+  // hull-identity encoding: empty = (+big, -big); values are read positions
+  std::vector<int32_t> dlo(n, kBig), dhi(n, -kBig);
+  std::vector<char> direct(n, 0);
+  for (int32_t a = 0; a < m; ++a) {
+    int32_t v = css_path[ch[a]];
+    dlo[v] = std::min(dlo[v], std::max(cv[a] - kWidth, 0));
+    dhi[v] = std::max(dhi[v], std::min(cv[a] + kWidth, I));
+    direct[v] = 1;
+  }
+
+  std::vector<int32_t> flo(dlo), fhi(dhi);
+  for (int32_t v : topo)
+    if (!direct[v] && !g.preds[v].empty()) {
+      int32_t b = kBig, e = -kBig;
+      for (int32_t p : g.preds[v])
+        if (flo[p] <= fhi[p]) {
+          b = std::min(b, std::min(flo[p] + 1, I));
+          e = std::max(e, std::min(fhi[p] + 1, I));
+        }
+      flo[v] = b;
+      fhi[v] = e;
+    }
+  std::vector<int32_t> rlo(dlo), rhi(dhi);
+  for (auto it = topo.rbegin(); it != topo.rend(); ++it) {
+    int32_t v = *it;
+    if (!direct[v] && !g.succs[v].empty()) {
+      int32_t b = kBig, e = -kBig;
+      for (int32_t s : g.succs[v])
+        if (rlo[s] <= rhi[s]) {
+          b = std::min(b, std::max(rlo[s] - 1, 0));
+          e = std::max(e, std::max(rhi[s] - 1, 0));
+        }
+      rlo[v] = b;
+      rhi[v] = e;
+    }
+  }
+
+  std::vector<int32_t> bands(2 * n);
+  for (size_t v = 0; v < n; ++v) {
+    int32_t b = std::min(flo[v], rlo[v]);
+    int32_t e = std::max(fhi[v], rhi[v]);
+    if (b > e) {  // both closures empty: full width
+      b = 0;
+      e = I;
+    }
+    // read positions [b, e] -> DP rows [b, e+2): row i consumes read
+    // position i-1, +1 more so a trailing delete/extra row is reachable
+    int32_t lo = std::max(0, std::min(b, I));
+    int32_t hi = std::min(I + 1, std::max(e + 2, lo + 1));
+    bands[2 * v] = lo;
+    bands[2 * v + 1] = hi;
+  }
+  return bands;
+}
+
 // LOCAL alignment of `read` against the DAG (PoaGraph.try_add_read).
-Plan TryAddRead(const Graph& g, std::vector<int8_t> read, bool rc) {
+// `bands` (empty = unbanded) restricts vertex v's fill to DP rows
+// [bands[2v], bands[2v+1]) -- the SDP-anchored banding of SdpBands().
+Plan TryAddRead(const Graph& g, const std::vector<int32_t>& topo,
+                std::vector<int8_t> read, bool rc,
+                const std::vector<int32_t>& bands) {
   Plan p;
   p.rc = rc;
   int32_t I = static_cast<int32_t>(read.size());
   size_t n = g.base.size();
   int32_t w = I + 1;
-  size_t W = static_cast<size_t>(w);  // size_t stride: V*(I+1) can pass 2^31
-  p.cols.assign(n * W, 0.0f);
-  p.mpred.assign(n * W, -1);
-  p.dpred.assign(n * W, -1);
+
+  p.lo.resize(n);
+  p.hi.resize(n);
+  p.off.resize(n);
+  int64_t total = 0;
+  for (size_t v = 0; v < n; ++v) {
+    p.lo[v] = bands.empty() ? 0 : bands[2 * v];
+    p.hi[v] = bands.empty() ? w : bands[2 * v + 1];
+    p.off[v] = total;
+    total += p.hi[v] - p.lo[v];
+  }
+  p.cols.assign(total, 0.0f);
+  p.mpred.assign(total, -1);
+  p.dpred.assign(total, -1);
   std::vector<float> best_m(w), best_d(w);
   static const std::vector<int32_t> kNoPred{-1};
 
-  for (int32_t v : TopoOrder(g)) {
+  for (int32_t v : topo) {
     int8_t vb = g.base[v];
-    std::fill(best_m.begin(), best_m.end(), kNegInf);
-    std::fill(best_d.begin(), best_d.end(), kNegInf);
-    int32_t* bm = &p.mpred[v * W];
-    int32_t* bd = &p.dpred[v * W];
+    const int32_t lo = p.lo[v], hi = p.hi[v];
+    std::fill(best_m.begin() + lo, best_m.begin() + hi, kNegInf);
+    std::fill(best_d.begin() + lo, best_d.begin() + hi, kNegInf);
+    int32_t* bm = &p.mpred[p.off[v]];  // banded: index with [i - lo]
+    int32_t* bd = &p.dpred[p.off[v]];
     const auto& plist = g.preds[v].empty() ? kNoPred : g.preds[v];
     for (int32_t pr : plist) {
-      const float* pc = pr < 0 ? nullptr : &p.cols[pr * W];
-      for (int32_t i = 1; i < w; ++i) {
+      for (int32_t i = std::max(lo, 1); i < hi; ++i) {
         float sub = read[i - 1] == vb ? kMatch : kMismatch;
-        float m = (pc ? pc[i - 1] : 0.0f) + sub;
+        float m = (pr < 0 ? 0.0f : p.Cell(pr, i - 1)) + sub;
         if (m > best_m[i]) {
           best_m[i] = m;
-          bm[i] = pr;
+          bm[i - lo] = pr;
         }
       }
-      for (int32_t i = 0; i < w; ++i) {
-        float d = (pc ? pc[i] : 0.0f) + kDelete;
+      for (int32_t i = lo; i < hi; ++i) {
+        float d = (pr < 0 ? 0.0f : p.Cell(pr, i)) + kDelete;
         if (d > best_d[i]) {
           best_d[i] = d;
-          bd[i] = pr;
+          bd[i - lo] = pr;
         }
       }
     }
-    float* col = &p.cols[v * W];
-    float run = kNegInf;
-    for (int32_t i = 0; i < w; ++i) {
+    float* col = &p.cols[p.off[v]];
+    float run = kNegInf;  // row lo-1 is out of band: 0 + kInsert < 0 <= b
+    for (int32_t i = lo; i < hi; ++i) {
       float b = std::max(0.0f, std::max(best_m[i], best_d[i]));
       run = std::max(b, run + kInsert);
-      col[i] = run;
+      col[i - lo] = run;
     }
   }
   // best local end: first strict max in (vertex, row) flat order
-  for (size_t f = 0; f < p.cols.size(); ++f)
-    if (p.cols[f] > p.score) {
-      p.score = p.cols[f];
-      p.best_vertex = static_cast<int32_t>(f / W);
-      p.best_row = static_cast<int32_t>(f % W);
+  for (size_t v = 0; v < n; ++v)
+    for (int32_t i = p.lo[v]; i < p.hi[v]; ++i) {
+      float c = p.cols[p.off[v] + i - p.lo[v]];
+      if (c > p.score) {
+        p.score = c;
+        p.best_vertex = static_cast<int32_t>(v);
+        p.best_row = i;
+      }
     }
   p.read = std::move(read);
   return p;
@@ -385,7 +588,6 @@ Plan TryAddRead(const Graph& g, std::vector<int8_t> read, bool rc) {
 std::vector<int32_t> CommitAdd(Graph& g, const Plan& plan) {
   const std::vector<int8_t>& read = plan.read;
   int32_t I = static_cast<int32_t>(read.size());
-  size_t w = static_cast<size_t>(I) + 1;  // size_t stride (see TryAddRead)
   std::vector<int32_t> path(I, -1);
 
   auto new_chain_vertex = [&](int32_t i, int32_t fork) {
@@ -405,17 +607,18 @@ std::vector<int32_t> CommitAdd(Graph& g, const Plan& plan) {
   int32_t v = plan.best_vertex;
   int32_t prev_visited = -1;
   while (v >= 0 && i >= 0) {
-    float cell = plan.cols[v * w + i];
+    if (!plan.InBand(v, i)) break;  // walked outside the band: StartMove
+    float cell = plan.Cell(v, i);
     int8_t vb = g.base[v];
-    int32_t mp = plan.mpred[v * w + i];
-    int32_t dp = plan.dpred[v * w + i];
+    int32_t mp = plan.MPred(v, i);
+    int32_t dp = plan.DPred(v, i);
     float m_val = kNegInf, e_val = kNegInf;
     if (i > 0) {
       float sub = read[i - 1] == vb ? kMatch : kMismatch;
-      m_val = (mp >= 0 ? plan.cols[mp * w + i - 1] : 0.0f) + sub;
-      e_val = plan.cols[v * w + i - 1] + kInsert;
+      m_val = (mp >= 0 ? plan.Cell(mp, i - 1) : 0.0f) + sub;
+      e_val = plan.Cell(v, i - 1) + kInsert;
     }
-    float d_val = (dp >= 0 ? plan.cols[dp * w + i] : 0.0f) + kDelete;
+    float d_val = (dp >= 0 ? plan.Cell(dp, i) : 0.0f) + kDelete;
 
     if (i > 0 && cell == m_val) {
       if (read[i - 1] == vb) {
@@ -501,9 +704,11 @@ void pbccs_poa_free(void* h) { delete static_cast<poa::Graph*>(h); }
 // Add a read in its better orientation if the LOCAL alignment score clears
 // min_score (SparsePoa.orient_and_add_read).  Writes the per-base vertex
 // path (oriented read order) and whether the reverse complement was used.
+// `band` != 0 enables the SDP-anchored banded fill (reference SdpRangeFinder
+// ranges against the current consensus, PoaGraphImpl.cpp:394-401).
 // Returns 1 if added, 0 if rejected.
 int32_t pbccs_poa_orient_add(void* h, const int8_t* read, int32_t n,
-                             float min_score, int32_t* out_path,
+                             float min_score, int32_t band, int32_t* out_path,
                              uint8_t* out_rc) {
   auto* g = static_cast<poa::Graph*>(h);
   if (n <= 0) return 0;
@@ -518,8 +723,48 @@ int32_t pbccs_poa_orient_add(void* h, const int8_t* read, int32_t n,
     int8_t b = read[n - 1 - i];
     rev[i] = b < 4 ? static_cast<int8_t>(3 - b) : b;
   }
-  poa::Plan pf = poa::TryAddRead(*g, std::move(fwd), false);
-  poa::Plan pr = poa::TryAddRead(*g, std::move(rev), true);
+  std::vector<int32_t> bands_fwd, bands_rev;
+  auto topo = poa::TopoOrder(*g);
+  if (band) {
+    auto css_path = poa::ConsensusPath(*g, 0);
+    // the min_cov=0 scores ConsensusPath just cached are banding-internal;
+    // do not let them masquerade as a caller-requested consensus
+    g->have_scores = false;
+    std::vector<int8_t> css_seq(css_path.size());
+    for (size_t i = 0; i < css_path.size(); ++i)
+      css_seq[i] = g->base[css_path[i]];
+    const int32_t k = (css_seq.size() < 1000 && fwd.size() < 1000) ? 6 : 10;
+    std::vector<int32_t> fh, fv, rh, rv;
+    poa::FindSeeds(css_seq, fwd, k, &fh, &fv);
+    poa::AnchorChain(&fh, &fv);
+    poa::FindSeeds(css_seq, rev, k, &rh, &rv);
+    poa::AnchorChain(&rh, &rv);
+    // Orientation triage by chain density (see poa/sparse.py): a much
+    // thinner chain marks the (almost surely) wrong strand, which gets a
+    // minimal one-row band -- scores ~0, loses the orientation contest --
+    // instead of a wide garbage band or a full O(V*I) fill.
+    auto minimal = [&]() {
+      std::vector<int32_t> b(2 * g->base.size());
+      for (size_t v = 0; v < g->base.size(); ++v) {
+        b[2 * v] = 0;
+        b[2 * v + 1] = 1;
+      }
+      return b;
+    };
+    const size_t nf = fh.size(), nr = rh.size();
+    if (nf >= 2 && nf >= 4 * nr) {
+      bands_fwd = poa::SdpBands(*g, topo, css_path, fh, fv, n);
+      bands_rev = minimal();
+    } else if (nr >= 2 && nr >= 4 * nf) {
+      bands_rev = poa::SdpBands(*g, topo, css_path, rh, rv, n);
+      bands_fwd = minimal();
+    } else {
+      bands_fwd = poa::SdpBands(*g, topo, css_path, fh, fv, n);
+      bands_rev = poa::SdpBands(*g, topo, css_path, rh, rv, n);
+    }
+  }
+  poa::Plan pf = poa::TryAddRead(*g, topo, std::move(fwd), false, bands_fwd);
+  poa::Plan pr = poa::TryAddRead(*g, topo, std::move(rev), true, bands_rev);
   poa::Plan& plan = pf.score >= pr.score ? pf : pr;
   if (plan.score < min_score) return 0;
   auto path = poa::CommitAdd(*g, plan);

@@ -45,9 +45,13 @@ def _homopolymer_hashes(k: int) -> np.ndarray:
 
 
 def find_seeds(seq1: np.ndarray, seq2: np.ndarray,
-               k: int = DEFAULT_SEED_SIZE) -> np.ndarray:
+               k: int = DEFAULT_SEED_SIZE,
+               max_occ: int | None = None) -> np.ndarray:
     """(N, 2) int32 array of (pos1, pos2) shared-k-mer seeds, homopolymer
-    k-mers masked (reference FindSeeds, SparseAlignment.h:100-137)."""
+    k-mers masked (reference FindSeeds, SparseAlignment.h:100-137).
+    `max_occ` additionally masks k-mers occurring more than that many times
+    in seq1 (the reference FilterSeeds quota intent) -- used by the POA
+    banding to bound seed growth on repetitive long inserts."""
     h1 = kmer_hashes(seq1, k)
     h2 = kmer_hashes(seq2, k)
     if not len(h1) or not len(h2):
@@ -60,6 +64,8 @@ def find_seeds(seq1: np.ndarray, seq2: np.ndarray,
     lo = np.searchsorted(sorted_h1, h2, side="left")
     hi = np.searchsorted(sorted_h1, h2, side="right")
     counts = np.where(ok2, hi - lo, 0)
+    if max_occ is not None:
+        counts = np.where(counts > max_occ, 0, counts)
     total = int(counts.sum())
     if total == 0:
         return np.zeros((0, 2), np.int32)
@@ -134,10 +140,11 @@ def chain_seeds(seeds: np.ndarray, k: int,
 
 
 def sparse_align(seq1: np.ndarray, seq2: np.ndarray,
-                 k: int = DEFAULT_SEED_SIZE) -> np.ndarray:
+                 k: int = DEFAULT_SEED_SIZE,
+                 max_occ: int | None = None) -> np.ndarray:
     """Find + chain seeds between two int8 base vectors (reference
     SparseAlign<TSize>, SparseAlignment.h:294-313); (N, 2) (pos1, pos2)."""
-    return chain_seeds(find_seeds(seq1, seq2, k), k)
+    return chain_seeds(find_seeds(seq1, seq2, k, max_occ), k)
 
 
 def anchor_bands(chain: np.ndarray, len1: int, len2: int,

@@ -64,7 +64,7 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.pbccs_poa_orient_add.restype = ctypes.c_int32
     lib.pbccs_poa_orient_add.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_float,
-        ctypes.c_void_p, ctypes.c_void_p]
+        ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
     lib.pbccs_poa_consensus.restype = ctypes.c_int32
     lib.pbccs_poa_consensus.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
@@ -140,13 +140,15 @@ class NativePoa:
 
     def orient_add(self, read: np.ndarray, min_score: float = 0.0):
         """(path, reverse_complemented) or None when rejected."""
+        from pbccs_tpu.poa.banding import banding_enabled
+
         r = np.ascontiguousarray(read, np.int8)
         n = len(r)
         path = np.zeros(n, np.int32)
         rc = ctypes.c_uint8(0)
         added = self._lib.pbccs_poa_orient_add(
             self._h, r.ctypes.data_as(ctypes.c_void_p), n,
-            ctypes.c_float(min_score),
+            ctypes.c_float(min_score), int(banding_enabled()),
             path.ctypes.data_as(ctypes.c_void_p), ctypes.byref(rc))
         if not added:
             return None

@@ -49,6 +49,7 @@ class AlignmentPlan:
     cols: np.ndarray       # (n_idx, I+1) scores per aligned vertex column
     match_pred: np.ndarray  # (n_idx, I+1) best predecessor for match move
     del_pred: np.ndarray    # (n_idx, I+1) best predecessor for delete move
+    ranges: np.ndarray | None = None  # (n_idx, 2) banded DP rows, None=full
 
 
 class PoaGraph:
@@ -117,56 +118,66 @@ class PoaGraph:
         self._tag_span(path[0], path[-1])
         return path
 
-    def try_add_read(self, read: np.ndarray, reverse_complemented: bool = False
-                     ) -> AlignmentPlan:
-        """LOCAL-align `read` against the current graph without mutating it."""
+    def try_add_read(self, read: np.ndarray, reverse_complemented: bool = False,
+                     ranges: np.ndarray | None = None,
+                     order: list[int] | None = None) -> AlignmentPlan:
+        """LOCAL-align `read` against the current graph without mutating it.
+
+        `ranges` (from poa.banding.sdp_vertex_ranges) bands each vertex's
+        column to DP rows [lo, hi); cells outside the band keep value 0 =
+        "a LOCAL alignment may start here", so the banded fill stays a
+        well-formed LOCAL DP and compute drops to O(V * band).  Storage
+        here remains full-width (the native engine stores only the bands;
+        this fallback favors simplicity).  `order` lets the caller reuse an
+        already-computed topological order."""
         I = len(read)
-        order = self.topo_order()
+        order = self.topo_order() if order is None else order
         n = len(self.base)
-        idx_of = np.full(n, -1, np.int64)
-        for k, v in enumerate(order):
-            idx_of[v] = k
 
         cols = np.zeros((n, I + 1), np.float32)
         match_pred = np.full((n, I + 1), -1, np.int64)
         del_pred = np.full((n, I + 1), -1, np.int64)
         zeros = np.zeros(I + 1, np.float32)
         ramp = INSERT_S * np.arange(I + 1, dtype=np.float32)
+        subs = np.where(read[None, :] == np.arange(4)[:, None],
+                        MATCH_S, MISMATCH_S).astype(np.float32)
 
         for v in order:
-            vb = self.base[v]
-            sub = np.where(read == vb, MATCH_S, MISMATCH_S).astype(np.float32)
-            best_m = np.full(I + 1, -np.inf, np.float32)
-            best_d = np.full(I + 1, -np.inf, np.float32)
-            bm_pred = np.full(I + 1, -1, np.int64)
-            bd_pred = np.full(I + 1, -1, np.int64)
+            lo, hi = (0, I + 1) if ranges is None else map(int, ranges[v])
+            L = hi - lo
+            s = max(lo, 1)  # first row with a match/extra move
+            sub = subs[self.base[v]] if 0 <= self.base[v] < 4 \
+                else np.full(I, MISMATCH_S, np.float32)
+            best_m = np.full(L, -np.inf, np.float32)
+            best_d = np.full(L, -np.inf, np.float32)
+            bm_pred = np.full(L, -1, np.int64)
+            bd_pred = np.full(L, -1, np.int64)
             preds = self.preds[v] or [-1]
             for p in preds:
                 pc = zeros if p < 0 else cols[p]
-                m = np.empty(I + 1, np.float32)
-                m[0] = -np.inf
-                m[1:] = pc[:-1] + sub
+                m = np.full(L, -np.inf, np.float32)
+                m[s - lo:] = pc[s - 1: hi - 1] + sub[s - 1: hi - 1]
                 upd = m > best_m
                 best_m = np.where(upd, m, best_m)
                 bm_pred[upd] = p
-                d = pc + DELETE_S
+                d = pc[lo:hi] + DELETE_S
                 upd = d > best_d
                 best_d = np.where(upd, d, best_d)
                 bd_pred[upd] = p
             # cell = max(0, match, delete, extra) where extra chains within
             # the column: solved by prefix-max of (b - insert_ramp).
             b = np.maximum(0.0, np.maximum(best_m, best_d))
-            col = np.maximum.accumulate(b - ramp) + ramp
-            cols[v] = col
-            match_pred[v] = bm_pred
-            del_pred[v] = bd_pred
+            cols[v, lo:hi] = np.maximum.accumulate(b - ramp[lo:hi]) + ramp[lo:hi]
+            match_pred[v, lo:hi] = bm_pred
+            del_pred[v, lo:hi] = bd_pred
 
         # best local end anywhere (EndMove, LOCAL)
         flat = int(np.argmax(cols))
         best_vertex, best_row = divmod(flat, I + 1)
         score = float(cols[best_vertex, best_row])
         return AlignmentPlan(score, np.asarray(read), reverse_complemented,
-                             best_vertex, best_row, cols, match_pred, del_pred)
+                             best_vertex, best_row, cols, match_pred, del_pred,
+                             ranges)
 
     def commit_add(self, plan: AlignmentPlan) -> list[int]:
         """Thread the read along the traceback of `plan`; returns the read
@@ -198,6 +209,9 @@ class PoaGraph:
         v = plan.best_vertex
         prev_visited = -1  # reference's `v`: vertex last visited in traceback
         while v >= 0 and i >= 0:
+            if plan.ranges is not None and not (
+                    plan.ranges[v, 0] <= i < plan.ranges[v, 1]):
+                break  # walked outside the band: treat as StartMove
             cell = cols[v, i]
             vb = self.base[v]
             mp = plan.match_pred[v, i]

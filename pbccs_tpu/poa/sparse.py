@@ -16,7 +16,10 @@ import dataclasses
 import numpy as np
 
 from pbccs_tpu import native
+from pbccs_tpu.align.seeds import find_seeds
 from pbccs_tpu.models.arrow.params import revcomp
+from pbccs_tpu.poa.banding import (_MAX_OCC, anchor_chain, anchor_k,
+                                   banding_enabled, sdp_vertex_ranges)
 from pbccs_tpu.poa.graph import PoaGraph
 
 
@@ -68,8 +71,51 @@ class SparsePoa:
             self.read_paths.append(path)
             self.reverse_complemented.append(False)
             return 0
-        fwd = self._graph.try_add_read(read, False)
-        rev = self._graph.try_add_read(revcomp(read), True)
+        ranges_fwd = ranges_rev = None
+        g = self._graph
+        order = g.topo_order()
+        if banding_enabled():
+            # the reference computes SDP ranges against the graph's current
+            # consensus each TryAddRead (PoaGraphImpl.cpp:394-401)
+            css_path = g.consensus_path(0)
+            # the min_cov=0 scores consensus_path just cached are
+            # banding-internal; do not let them masquerade as a
+            # caller-requested consensus
+            del g.vertex_score
+            css = np.asarray([g.base[v] for v in css_path], np.int8)
+            rc = revcomp(read)
+            k = anchor_k(len(css), len(read))
+            chain_f = anchor_chain(find_seeds(css, read, k, max_occ=_MAX_OCC))
+            chain_r = anchor_chain(find_seeds(css, rc, k, max_occ=_MAX_OCC))
+            # Orientation triage by chain density: the wrong strand chains
+            # only a few spurious anchors, so a much thinner chain means
+            # that orientation is (almost surely) wrong -- give it a
+            # minimal one-row band (scores ~0, loses the orientation
+            # contest) instead of a wide garbage band or a full O(V*I)
+            # fill.  Comparable chains (palindromic inserts) band both.
+            minimal = np.zeros((len(g.base), 2), np.int64)
+            minimal[:, 1] = 1
+            if len(chain_f) >= 2 and len(chain_f) >= 4 * len(chain_r):
+                ranges_fwd = sdp_vertex_ranges(len(g.base), order, g.preds,
+                                               g.succs, css_path, chain_f,
+                                               len(read))
+                ranges_rev = minimal
+            elif len(chain_r) >= 2 and len(chain_r) >= 4 * len(chain_f):
+                ranges_rev = sdp_vertex_ranges(len(g.base), order, g.preds,
+                                               g.succs, css_path, chain_r,
+                                               len(rc))
+                ranges_fwd = minimal
+            else:
+                ranges_fwd = sdp_vertex_ranges(len(g.base), order, g.preds,
+                                               g.succs, css_path, chain_f,
+                                               len(read))
+                ranges_rev = sdp_vertex_ranges(len(g.base), order, g.preds,
+                                               g.succs, css_path, chain_r,
+                                               len(rc))
+        fwd = self._graph.try_add_read(read, False, ranges=ranges_fwd,
+                                       order=order)
+        rev = self._graph.try_add_read(revcomp(read), True, ranges=ranges_rev,
+                                       order=order)
         plan = fwd if fwd.score >= rev.score else rev
         if plan.score < min_score_to_add:
             return -1
