@@ -39,7 +39,7 @@ from pbccs_tpu.models.arrow.scorer import (
     ADD_ALPHABETAMISMATCH,
     ADD_POOR_ZSCORE,
     ADD_SUCCESS,
-    fill_alpha_beta_batch,
+    fill_alpha_beta_batch_zr,
     fills_use_pallas,
     interior_read_scores,
     mated_mask,
@@ -80,9 +80,9 @@ class ZmwTask:
     tends: Sequence[int]
 
 
-@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas", "mesh"))
 def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
-                 width: int, use_pallas: bool):
+                 width: int, use_pallas: bool, mesh: Mesh | None = None):
     """Per-ZMW template tracks + per-read window fills + moments.
 
     All leading axes are (Z, ...) with reads (Z, R, Imax).  `tables` are the
@@ -113,16 +113,10 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
     (win_tpl, win_trans, wlens, trans_f, tpl_r, trans_r, table, mu, var) = \
         jax.vmap(one_zmw)(tpls, tlens, tables, strands, tstarts, tends)
 
-    Z, R = reads.shape[:2]
-    flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
-    alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
-        flat(reads), flat(rlens), flat(win_tpl), flat(win_trans),
-        flat(wlens), width, use_pallas)
-    unflat = lambda a: a.reshape((Z, R) + a.shape[1:])
-    alpha = jax.tree.map(unflat, alpha)
-    beta = jax.tree.map(unflat, beta)
+    alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch_zr(
+        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas, mesh)
     return (win_tpl, win_trans, wlens, alpha, beta,
-            unflat(ll_a), unflat(ll_b), unflat(apre), unflat(bsuf),
+            ll_a, ll_b, apre, bsuf,
             trans_f, tpl_r, trans_r, table, mu, var)
 
 
@@ -426,11 +420,11 @@ class BatchPolisher:
             self._tstarts_dev,
             self._tends_dev,
             self._W,
-            # pallas_call has no SPMD partitioning rule: under a mesh GSPMD
-            # would all-gather the flattened coefficient tensors and run the
-            # kernel replicated on every device, so mesh runs stay on the
-            # shardable JAX fill path.
-            use_pallas=fills_use_pallas() and self.mesh is None)
+            # under a mesh the Pallas fills run per-device inside
+            # jax.shard_map (fill_alpha_beta_batch_zr); pallas_call itself
+            # has no GSPMD partitioning rule
+            use_pallas=fills_use_pallas(),
+            mesh=self.mesh)
         self.alpha, self.beta = alpha, beta
         self._tpl_dev = self._shard(tl)
         self._tpl32_dev = self._tpl_dev.astype(jnp.int32)

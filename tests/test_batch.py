@@ -84,6 +84,30 @@ def test_batch_sharded_matches_unsharded(rng):
         np.testing.assert_allclose(got[z], want[z], rtol=1e-4, atol=1e-3)
 
 
+def test_batch_sharded_pallas_fills(rng, monkeypatch):
+    """Mesh runs keep the Pallas fill kernel: fills run inside
+    jax.shard_map per device (interpret mode on CPU), and sharded scores
+    match the unsharded JAX-path scores."""
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    tasks, _ = make_tasks(rng, n_zmws=4, tpl_len=60, n_passes=4)
+    muts_per_zmw = [mutlib.enumerate_unique(t.tpl)[:20] for t in tasks]
+
+    plain = BatchPolisher(tasks)
+    want = plain.score_mutations(muts_per_zmw)
+
+    from pbccs_tpu.ops.fwdbwd_pallas import fills_use_pallas
+
+    monkeypatch.setenv("PBCCS_PALLAS", "1")
+    assert fills_use_pallas()
+    mesh = make_zmw_mesh(n_zmw=4, n_read=2)
+    sharded = BatchPolisher(tasks, mesh=mesh)
+    got = sharded.score_mutations(muts_per_zmw)
+
+    assert np.array_equal(sharded.active[:4, :4], plain.active[:4, :4])
+    for z in range(4):
+        np.testing.assert_allclose(got[z], want[z], rtol=1e-4, atol=1e-3)
+
+
 def test_batch_global_zscores_finite(rng):
     tasks, _ = make_tasks(rng, n_zmws=2, tpl_len=60, n_passes=4)
     batch = BatchPolisher(tasks)
