@@ -336,10 +336,58 @@ class ArrowMultiReadScorer:
         self.active = np.zeros(R, bool)
         self.statuses = np.full(self.n_reads, ADD_OTHER, np.int32)
         self.zscores = np.full(self.n_reads, np.nan)
+        self.band_retried = False
+        self.n_band_retries = 0
 
         self._rebuild(first=True)
+        failed = self.statuses == ADD_ALPHABETAMISMATCH
+        if failed.any():
+            # The reference refills a mismatched alpha/beta pair up to 5
+            # times with rebanding before dropping the read
+            # (SimpleRecursor.cpp:642-691).  The static-band analogue is one
+            # escalation of the whole scorer to a 2x band -- per-read widths
+            # would break the (R, J+1, W) lockstep shapes.  Escalation is
+            # kept only when it MATES more reads: for insert-heavy reads the
+            # float32 in-column dynamic range (~87 nats/column) binds before
+            # band coverage does, and a wider band can then lose mass and
+            # unmate reads the narrow band kept, so the better width wins.
+            # The first build is snapshotted so the revert (the common case)
+            # and any failure of the speculative wide build (e.g. device
+            # memory) restore it without a third set of fills.
+            snap = {k: getattr(self, k) for k in self._RETRY_SNAPSHOT}
+            gates = (self.statuses.copy(), self.active.copy(),
+                     self.zscores.copy())
+            w0 = self._W
+            n0 = int((self.statuses != ADD_ALPHABETAMISMATCH).sum())
+            try:
+                self._W *= 2
+                self._reset_gates()
+                self._rebuild(first=True)
+                better = int((self.statuses
+                              != ADD_ALPHABETAMISMATCH).sum()) > n0
+            except Exception:  # noqa: BLE001 -- speculative build only
+                better = False
+            if better:
+                self.band_retried = True
+                self.n_band_retries = int(
+                    (failed & (self.statuses != ADD_ALPHABETAMISMATCH)).sum())
+            else:
+                self._W = w0
+                for k, v in snap.items():
+                    setattr(self, k, v)
+                self.statuses, self.active, self.zscores = gates
 
     # ------------------------------------------------------------------ setup
+
+    _RETRY_SNAPSHOT = (
+        "tpl_f", "trans_f", "tpl_r", "trans_r", "win_tpl", "win_trans",
+        "wlens", "alpha", "beta", "a_prefix", "b_suffix", "baselines",
+        "_ll_mu", "_ll_var")
+
+    def _reset_gates(self) -> None:
+        self.statuses[:] = ADD_OTHER
+        self.active[:] = False
+        self.zscores[:] = np.nan
 
     def _template_tensors(self):
         L = len(self.tpl)

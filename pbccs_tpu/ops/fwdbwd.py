@@ -20,6 +20,14 @@ TPU-first re-design of the reference's adaptive-banded recursor
 * The reference's ScaledMatrix rescales every column by its max to stay in
   natural scale (Matrix/ScaledMatrix-inl.hpp:74-123).  Same here: per-column
   max-rescale, log-scale accumulated, so float32 suffices in the inner loop.
+  Dynamic-range note: float32 holds ~87 nats of in-column range below each
+  column's max, so paths further below it (e.g. contiguous insert runs over
+  ~20 bases) flush to zero and alpha/beta can disagree -- such reads drop at
+  the mating gate, after one wider-band retry by the host (scorer.py).
+  This is MORE permissive than the reference, whose adaptive band keeps
+  only cells within ScoreDiff = 12.5 nats of the column max
+  (SimpleRecursor.cpp:101-158) and drops the same reads through
+  AlphaBetaMismatchException after 5 flip-flop refills.
 
 Matrix convention matches the reference: (I+1) read rows x (J+1) template
 columns, both endpoints pinned to Match; trans[k] are the probabilities of

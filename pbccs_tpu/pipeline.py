@@ -25,7 +25,8 @@ from pbccs_tpu.models.arrow.refine import (
     predicted_accuracy,
     refine_consensus,
 )
-from pbccs_tpu.models.arrow.scorer import ADD_SUCCESS, ArrowMultiReadScorer
+from pbccs_tpu.models.arrow.scorer import (ADD_ALPHABETAMISMATCH, ADD_SUCCESS,
+                                           ArrowMultiReadScorer)
 from pbccs_tpu.poa.sparse import PoaAlignmentSummary, SparsePoa
 
 # Local-context adapter flags (reference pbbam LocalContextFlags; a subread is
@@ -342,18 +343,14 @@ def _finish_zmw(prep: PreparedZmw, settings: ConsensusSettings,
         elapsed_ms=elapsed_ms)
 
 
-def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
-                  ) -> tuple[Failure, ConsensusResult | None]:
-    """The per-ZMW pipeline (reference Consensus, Consensus.h:396-553)."""
-    settings = settings or ConsensusSettings()
+def polish_prepared(prep: PreparedZmw, settings: ConsensusSettings
+                    ) -> tuple[Failure, ConsensusResult | None]:
+    """The serial polish half of the per-ZMW pipeline, given an already
+    prepared (filtered + drafted + mapped) ZMW.  The serial scorer owns the
+    wider-band AddRead retry."""
     t0 = time.monotonic()
-
-    failure, prep = prepare_chunk(chunk, settings)
-    if failure is not None:
-        return failure, None
-
     scorer = ArrowMultiReadScorer(
-        prep.css, chunk.snr,
+        prep.css, prep.chunk.snr,
         [m.seq for m in prep.mapped],
         [m.strand for m in prep.mapped],
         [m.tpl_start for m in prep.mapped],
@@ -370,10 +367,20 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
     if not refine.converged:
         return Failure.NON_CONVERGENT, None
     qvs = scorer.consensus_qvs()
-    elapsed_ms = (time.monotonic() - t0) * 1e3
+    elapsed_ms = prep.prep_ms + (time.monotonic() - t0) * 1e3
     return _finish_zmw(prep, settings, scorer.tpl, qvs, refine,
                        scorer.zscores, global_z, status_counts, n_passes,
                        elapsed_ms)
+
+
+def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
+                  ) -> tuple[Failure, ConsensusResult | None]:
+    """The per-ZMW pipeline (reference Consensus, Consensus.h:396-553)."""
+    settings = settings or ConsensusSettings()
+    failure, prep = prepare_chunk(chunk, settings)
+    if failure is not None:
+        return failure, None
+    return polish_prepared(prep, settings)
 
 
 def process_chunks(chunks: Sequence[Chunk],
@@ -428,9 +435,18 @@ def process_chunks(chunks: Sequence[Chunk],
         gate_info = []
         for z, p in enumerate(preps):
             gate_info.append(_read_gates(p, polisher.statuses[z], settings))
+        # ZMWs that shed reads to the alpha/beta mating gate re-run on the
+        # serial path, whose scorer retries the whole ZMW at a 2x band
+        # before dropping (the reference's reband-then-drop semantics,
+        # SimpleRecursor.cpp:642-691); the lockstep batch cannot widen one
+        # ZMW's band without breaking its static shapes
+        reband = {z for z, p in enumerate(preps)
+                  if (polisher.statuses[z, : len(p.mapped)]
+                      == ADD_ALPHABETAMISMATCH).any()}
         # gate-failed ZMWs are excluded from refinement/QV (the serial path
         # returns before polishing them); their batch slots stay idle
-        skip = {z for z, g in enumerate(gate_info) if g[0] is not None}
+        skip = reband | {z for z, g in enumerate(gate_info)
+                         if g[0] is not None}
         # z-score statistics are reported for the draft template, before
         # refinement (parity with the serial path)
         global_zs = polisher.global_zscores()
@@ -446,6 +462,8 @@ def process_chunks(chunks: Sequence[Chunk],
         # cannot double-count ZMWs when the serial fallback reruns them
         bt = ResultTally()
         for z, p in enumerate(preps):
+            if z in reband:
+                continue  # re-run below with the wider-band serial scorer
             failure, status_counts, n_passes = gate_info[z]
             if failure is not None:
                 bt.tally(failure)
@@ -455,6 +473,20 @@ def process_chunks(chunks: Sequence[Chunk],
                 p, settings, polisher.tpls[z], qvs[z], refine_results[z],
                 polisher.zscores[z, :nr], global_zs[z], status_counts,
                 n_passes, p.prep_ms + polish_ms)
+            bt.tally(failure)
+            if result is not None:
+                bt.results.append(result)
+        # rebanded ZMWs reuse their existing prep (the draft stage is not
+        # at fault); only the polish half re-runs, serially.  Note an
+        # alternative would keep these in the batched model via a second
+        # 2x-band BatchPolisher over the reband set; mating drops are rare
+        # enough that the serial path is the simpler sound choice.
+        for z in sorted(reband):
+            try:
+                failure, result = polish_prepared(preps[z], settings)
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                bt.tally(Failure.OTHER)
+                continue
             bt.tally(failure)
             if result is not None:
                 bt.results.append(result)

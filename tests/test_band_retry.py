@@ -1,0 +1,136 @@
+"""Wider-band retry at AddRead time.
+
+Reference semantics: a read whose alpha/beta disagree is refilled with
+rebanding up to 5 times before being dropped (reference
+ConsensusCore/src/C++/Arrow/SimpleRecursor.cpp:642-691).  The static-band
+analogue implemented here escalates the whole per-ZMW scorer to a 2x band
+once, keeping whichever width mates more reads.
+
+Empirical note these tests encode: with float32 natural-scale fills the
+in-column dynamic range (~87 nats) usually binds before band coverage
+does, so escalation must never be allowed to LOSE reads (a wider band can
+unmate insert-heavy reads the narrow band kept) -- the keep-better-width
+rule, and the revert test below, pin that down.
+"""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.models.arrow.scorer import (ADD_ALPHABETAMISMATCH,
+                                           ADD_SUCCESS, ArrowMultiReadScorer)
+from pbccs_tpu.simulate import simulate_zmw
+
+
+def _pathological_read(rng, tpl):
+    """A read with a big random block insertion: alpha/beta reliably
+    unmated at any width (float32 in-column underflow)."""
+    ins = rng.integers(0, 4, 120).astype(np.int8)
+    mid = len(tpl) // 2
+    return np.concatenate([tpl[:mid], ins, tpl[mid:]])
+
+
+def test_retry_attempted_then_reverted(rng):
+    """A pathological read triggers the escalation; since the wider band
+    mates no additional reads, the scorer reverts to the original width,
+    keeps the healthy reads, and drops the pathological one."""
+    tpl, reads, strands, snr = simulate_zmw(rng, 300, 4)
+    bad = _pathological_read(rng, tpl)
+    L = len(tpl)
+    sc = ArrowMultiReadScorer(tpl, snr, list(reads) + [bad],
+                              list(strands) + [0], [0] * 5, [L] * 5)
+    assert sc._W == sc.config.banding.band_width  # reverted
+    assert not sc.band_retried
+    assert (sc.statuses[:4] == ADD_SUCCESS).all()
+    assert sc.statuses[4] == ADD_ALPHABETAMISMATCH
+    assert sc.active[:4].all() and not sc.active[4]
+
+
+def test_retry_never_loses_reads(rng):
+    """Escalation keeps the narrow band when the wide one would shed reads
+    that currently mate (the width that mates more reads wins)."""
+    tpl, reads, strands, snr = simulate_zmw(rng, 300, 4)
+    bad = _pathological_read(rng, tpl)
+    L = len(tpl)
+    sc = ArrowMultiReadScorer(tpl, snr, list(reads) + [bad],
+                              list(strands) + [0], [0] * 5, [L] * 5)
+    n_kept = int((sc.statuses == ADD_SUCCESS).sum())
+
+    # same ZMW without the pathological read: no retry, same keeps
+    sc2 = ArrowMultiReadScorer(tpl, snr, list(reads), list(strands),
+                               [0] * 4, [L] * 4)
+    assert not sc2.band_retried
+    assert int((sc2.statuses == ADD_SUCCESS).sum()) == n_kept == 4
+
+
+def test_no_retry_on_clean_zmw(rng):
+    tpl, reads, strands, snr = simulate_zmw(rng, 250, 5)
+    L = len(tpl)
+    sc = ArrowMultiReadScorer(tpl, snr, list(reads), list(strands),
+                              [0] * 5, [L] * 5)
+    assert not sc.band_retried
+    assert sc.n_band_retries == 0
+    assert sc._W == sc.config.banding.band_width
+
+
+def test_scoring_still_consistent_after_retry_path(rng):
+    """The scorer remains usable (score == rescore invariant) after the
+    retry machinery ran, whatever width it settled on."""
+    from pbccs_tpu.models.arrow import mutations as mutlib
+
+    tpl, reads, strands, snr = simulate_zmw(rng, 200, 4)
+    bad = _pathological_read(rng, tpl)
+    L = len(tpl)
+    sc = ArrowMultiReadScorer(tpl, snr, list(reads) + [bad],
+                              list(strands) + [0], [0] * 5, [L] * 5)
+    muts = mutlib.enumerate_unique(tpl)[:12]
+    s1 = sc.score_mutations(muts)
+    s2 = sc.score_mutations(muts)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5)
+    assert np.isfinite(s1).all()
+
+
+def test_pipeline_reroutes_mating_drops_to_serial(rng, monkeypatch):
+    """A batch ZMW that sheds reads to the mating gate re-runs through the
+    serial path (whose scorer owns the band retry) and still yields.
+
+    The draft stage usually clips pathological reads before AddRead (their
+    unmatched span falls outside the POA extents), so the gate status is
+    injected at the BatchPolisher to exercise the reroute plumbing."""
+    import pbccs_tpu.parallel.batch as batchmod
+    from pbccs_tpu.pipeline import Chunk, Failure, Subread, process_chunks
+
+    chunks = []
+    for z in range(2):
+        tpl, reads, strands, snr = simulate_zmw(rng, 150, 6)
+        chunks.append(Chunk(f"rb/{z}",
+                            [Subread(f"rb/{z}/{i}", r)
+                             for i, r in enumerate(reads)], snr))
+
+    serial_ids = []
+    orig_polisher = batchmod.BatchPolisher
+
+    class DropInjectingPolisher(orig_polisher):
+        def __init__(self, tasks, **kw):
+            super().__init__(tasks, **kw)
+            # pretend ZMW rb/1's last read failed alpha/beta mating
+            for z, t in enumerate(tasks):
+                if t.id == "rb/1":
+                    self.statuses[z, len(t.reads) - 1] = \
+                        ADD_ALPHABETAMISMATCH
+                    self.active[z, len(t.reads) - 1] = False
+
+    monkeypatch.setattr(batchmod, "BatchPolisher", DropInjectingPolisher)
+
+    import pbccs_tpu.pipeline as pipemod
+    from pbccs_tpu.pipeline import polish_prepared as orig_polish_prepared
+
+    def tracking_polish_prepared(prep, settings):
+        serial_ids.append(prep.chunk.id)
+        return orig_polish_prepared(prep, settings)
+
+    monkeypatch.setattr(pipemod, "polish_prepared", tracking_polish_prepared)
+
+    tally = process_chunks(chunks)
+    assert serial_ids == ["rb/1"]          # only the shedding ZMW rerouted
+    assert tally.counts[Failure.SUCCESS] == 2
+    assert len(tally.results) == 2
