@@ -54,6 +54,7 @@ from pbccs_tpu.ops.mutation_score import (
     make_patches_fast,
 )
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
+from pbccs_tpu.runtime.timing import device_fetch
 from pbccs_tpu.utils import next_pow2
 
 # mutation-axis chunk: every scoring call uses this static M so one compiled
@@ -434,7 +435,7 @@ class BatchPolisher:
         if first:
             # one stacked fetch (device->host transfers cost ~0.1-0.25 s
             # each over the tunneled link, independent of payload size)
-            stats = np.asarray(jnp.stack([ll_a, ll_b, mu, var]), np.float64)
+            stats = device_fetch(jnp.stack([ll_a, ll_b, mu, var]), np.float64)
             ll_a_h, ll_b_h, mu_h, var_h = stats
             self.baselines = ll_b_h
             self._ll_mu = mu_h
@@ -697,7 +698,7 @@ class BatchPolisher:
 
         # one stacked fetch for the whole call: every device->host transfer
         # over the tunneled link costs ~0.1-0.25 s regardless of payload
-        stacked = np.asarray(_stack_chunks(states), np.float64)
+        stacked = device_fetch(_stack_chunks(states), np.float64)
         for c in range(n_chunks):
             lo = c * MUT_CHUNK
             for z in range(self.n_zmws):

@@ -1,0 +1,44 @@
+"""Device-wait accounting (the tracing/profiling subsystem, SURVEY.md §5).
+
+The polish stage's execution model batches all device work and fetches
+results at a handful of sync points (one stacked fetch per refinement
+round); everything else is host marshalling.  Routing those fetches
+through device_fetch() splits wall time into host-side vs
+device-wait-side, which over this environment's tunneled device link is
+the meaningful decomposition (each fetch blocks on dispatch + device
+execution + transfer).  bench.py reports device_wait_fraction from these
+counters; reset() starts a measurement window.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+_device_wait_s = 0.0
+_fetches = 0
+
+
+def device_fetch(arr, dtype=None) -> np.ndarray:
+    """np.asarray(arr) with the blocking time attributed to device wait."""
+    global _device_wait_s, _fetches
+    t0 = time.perf_counter()
+    out = np.asarray(arr, dtype) if dtype is not None else np.asarray(arr)
+    _device_wait_s += time.perf_counter() - t0
+    _fetches += 1
+    return out
+
+
+def reset() -> None:
+    global _device_wait_s, _fetches
+    _device_wait_s = 0.0
+    _fetches = 0
+
+
+def device_wait_seconds() -> float:
+    return _device_wait_s
+
+
+def fetch_count() -> int:
+    return _fetches
