@@ -60,17 +60,18 @@ def refine_consensus(scorer: ArrowMultiReadScorer,
 
         best = mutlib.best_subset(favorable, opts.mutation_separation)
 
-        # cycle avoidance (Consensus-inl.hpp:229-241)
-        next_tpl = mutlib.apply_mutations(scorer.tpl, best)
-        if len(best) > 1 and hash(next_tpl.tobytes()) in tpl_history:
-            best = [max(best, key=lambda m: m.score)]
+        # cycle avoidance (Consensus-inl.hpp:229-241): a multi-mutation
+        # subset whose result was already visited is trimmed to its best
+        # single mutation.  Like the reference, a repeated template does
+        # NOT terminate the loop: applying the mutation and iterating on
+        # lets mutations elsewhere shift the cycling site's score and
+        # break the cycle (observed to recover otherwise-lost ZMWs); a
+        # persistent cycle runs out the iteration budget and ends
+        # non-convergent, exactly as the reference's does.
+        if len(best) > 1:
             next_tpl = mutlib.apply_mutations(scorer.tpl, best)
-        # a single marginal mutation can also cycle (insert<->delete at one
-        # position when the extend+link estimate sits near zero); a repeated
-        # template terminates as non-convergent rather than burning the
-        # whole iteration budget
-        if hash(next_tpl.tobytes()) in tpl_history:
-            break
+            if hash(next_tpl.tobytes()) in tpl_history:
+                best = [max(best, key=lambda m: m.score)]
 
         res.n_applied += len(best)
         tpl_history.add(hash(scorer.tpl.tobytes()))

@@ -792,16 +792,14 @@ class BatchPolisher:
                     best_per_zmw.append([])
                     continue
                 best = mutlib.best_subset(fav, opts.mutation_separation)
-                nxt = mutlib.apply_mutations(self.tpls[z], best)
-                if len(best) > 1 and hash(nxt.tobytes()) in history[z]:
-                    best = [max(best, key=lambda m: m.score)]
+                # cycle avoidance (Consensus-inl.hpp:229-241): trim a
+                # visited multi-mutation result to its best single
+                # mutation, but keep iterating (a repeated template does
+                # not terminate; see models/arrow/refine.py)
+                if len(best) > 1:
                     nxt = mutlib.apply_mutations(self.tpls[z], best)
-                # single-mutation cycles (insert<->delete of one base with a
-                # near-zero score estimate) terminate as non-convergent
-                if hash(nxt.tobytes()) in history[z]:
-                    done[z] = True
-                    best_per_zmw.append([])
-                    continue
+                    if hash(nxt.tobytes()) in history[z]:
+                        best = [max(best, key=lambda m: m.score)]
                 history[z].add(hash(self.tpls[z].tobytes()))
                 results[z].n_applied += len(best)
                 best_per_zmw.append(best)
