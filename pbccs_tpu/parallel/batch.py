@@ -381,6 +381,16 @@ class BatchPolisher:
         return jax.device_put(np.asarray(arr),
                               NamedSharding(self.mesh, P(*parts)))
 
+    def _tpl_lengths(self) -> np.ndarray:
+        """(Z,) template lengths (padding rows = 2), cached between
+        apply_mutations calls; shared by the marshalling paths for their
+        mid-template default-dummy geometry."""
+        if getattr(self, "_tpl_lengths_cache", None) is None:
+            self._tpl_lengths_cache = np.array(
+                [len(self.tpls[z]) for z in range(self.n_zmws)]
+                + [2] * (self._Z - self.n_zmws), np.int32)
+        return self._tpl_lengths_cache
+
     def _template_arrays(self):
         Z = self._Z
         tl = np.full((Z, self._Jmax), 4, np.int8)
@@ -556,34 +566,52 @@ class BatchPolisher:
         em_any = fast_mask.any(axis=1)                      # (Z, M)
         counts = em_any.sum(axis=1)
         if counts.any():
-            idx_per_z = [np.nonzero(em_any[z])[0] for z in range(Z)]
-            n_slabs = (int(counts.max()) + EDGE_SLAB - 1) // EDGE_SLAB
+            # Vectorized ragged->dense marshalling: a stable argsort on
+            # ~em_any packs each row's edge-mutation indices to the front
+            # (True sorts before False), so every slab is a pure numpy
+            # gather with no per-(slab, Z) Python loop.
+            Mc = int(counts.max())
+            order = np.argsort(~em_any, axis=1, kind="stable")[:, :Mc]
+            packed_valid = np.take_along_axis(em_any, order, axis=1)
+            L_arr = self._tpl_lengths()
+            d_pos_f = np.broadcast_to((L_arr // 2)[:, None], (Z, Mc))
+            d_end_f = d_pos_f + 1
+            d_pos_r = np.broadcast_to((L_arr - L_arr // 2 - 1)[:, None],
+                                      (Z, Mc))
+            gath = lambda a: np.take_along_axis(a, order, axis=1)
+            g_pos_f = np.where(packed_valid, gath(pos_f), d_pos_f)
+            g_end_f = np.where(packed_valid, gath(end_f), d_end_f)
+            g_mtype = np.where(packed_valid, gath(mtype), SUB)
+            g_base_f = np.where(packed_valid, gath(base_f), 0)
+            g_pos_r = np.where(packed_valid, gath(pos_r), d_pos_r)
+            g_base_r = np.where(packed_valid, gath(base_r), 0)
+            g_mask = np.take_along_axis(fast_mask, order[:, None, :],
+                                        axis=2) & packed_valid[:, None, :]
+            n_slabs = (Mc + EDGE_SLAB - 1) // EDGE_SLAB
+            pad = n_slabs * EDGE_SLAB - Mc
+            if pad:
+                padz = lambda a, fill: np.concatenate(
+                    [a, np.broadcast_to(fill, a.shape[:-1] + (pad,))], axis=-1)
+                g_pos_f = padz(g_pos_f, d_pos_f[:, :1])
+                g_end_f = padz(g_end_f, d_end_f[:, :1])
+                g_mtype = padz(g_mtype, SUB)
+                g_base_f = padz(g_base_f, 0)
+                g_pos_r = padz(g_pos_r, d_pos_r[:, :1])
+                g_base_r = padz(g_base_r, 0)
+                g_mask = padz(g_mask, False)
+                order = padz(order, 0)
+                packed_valid = padz(packed_valid, False)
             for k in range(n_slabs):
-                spos_f = np.zeros((Z, EDGE_SLAB), np.int32)
-                send_f = np.ones((Z, EDGE_SLAB), np.int32)
-                smtype = np.full((Z, EDGE_SLAB), SUB, np.int32)
-                sbase_f = np.zeros((Z, EDGE_SLAB), np.int32)
-                spos_r = np.zeros((Z, EDGE_SLAB), np.int32)
-                sbase_r = np.zeros((Z, EDGE_SLAB), np.int32)
-                smask = np.zeros((Z, self._R, EDGE_SLAB), bool)
-                sel_idx = np.zeros((Z, EDGE_SLAB), np.int64)
-                used = np.zeros((Z, EDGE_SLAB), bool)
-                for z in range(self.n_zmws):
-                    L = len(self.tpls[z])
-                    spos_f[z], send_f[z] = L // 2, L // 2 + 1
-                    spos_r[z] = L - (L // 2) - 1
-                    mi = idx_per_z[z][k * EDGE_SLAB: (k + 1) * EDGE_SLAB]
-                    n = len(mi)
-                    if n:
-                        spos_f[z, :n] = pos_f[z, mi]
-                        send_f[z, :n] = end_f[z, mi]
-                        smtype[z, :n] = mtype[z, mi]
-                        sbase_f[z, :n] = base_f[z, mi]
-                        spos_r[z, :n] = pos_r[z, mi]
-                        sbase_r[z, :n] = base_r[z, mi]
-                        smask[z, :, :n] = fast_mask[z][:, mi]
-                        sel_idx[z, :n] = mi
-                        used[z, :n] = True
+                sl = slice(k * EDGE_SLAB, (k + 1) * EDGE_SLAB)
+                spos_f = np.ascontiguousarray(g_pos_f[:, sl], np.int32)
+                send_f = np.ascontiguousarray(g_end_f[:, sl], np.int32)
+                smtype = np.ascontiguousarray(g_mtype[:, sl], np.int32)
+                sbase_f = np.ascontiguousarray(g_base_f[:, sl], np.int32)
+                spos_r = np.ascontiguousarray(g_pos_r[:, sl], np.int32)
+                sbase_r = np.ascontiguousarray(g_base_r[:, sl], np.int32)
+                smask = np.ascontiguousarray(g_mask[:, :, sl])
+                sel_idx = np.ascontiguousarray(order[:, sl], np.int64)
+                used = np.ascontiguousarray(packed_valid[:, sl])
                 et_dev = _batch_edge_fast_totals(
                     self._reads_dev, self._rlens_dev,
                     self._strands_dev, self._tstarts_dev, self._tends_dev,
@@ -663,48 +691,64 @@ class BatchPolisher:
         rcs = [mutlib.reverse_complement_arrays(a, len(self.tpls[z]))
                for z, a in enumerate(arrs)]
         n_chunks = (Mmax + MUT_CHUNK - 1) // MUT_CHUNK
-        out = [np.zeros(a.size) for a in arrs]
+
+        # Ragged->dense marshalling without per-(chunk, Z) Python loops and
+        # without (Z, Mmax)-padded planes: the per-ZMW mutation arrays are
+        # concatenated once (actual data size, no padding) and every chunk's
+        # (Z, MUT_CHUNK) slab is one vectorized clipped gather, ~15 MB of
+        # transient per chunk regardless of Mmax.  Default dummies sit
+        # mid-template to stay interior & cheap.
+        sizes = np.array([a.size for a in arrs], np.int64)
+        offs = np.zeros(self.n_zmws + 1, np.int64)
+        np.cumsum(sizes, out=offs[1:])
+        catf = lambda field, src: np.concatenate(
+            [getattr(a, field) for a in src]) if offs[-1] else \
+            np.zeros(0, np.int32)
+        flat_pos_f = catf("start", arrs)
+        flat_end_f = catf("end", arrs)
+        flat_mtype = catf("mtype", arrs)
+        flat_base_f = catf("new_base", arrs)
+        flat_pos_r = catf("start", rcs)
+        flat_base_r = catf("new_base", rcs)
+
+        L_arr = self._tpl_lengths()
+        d_pos_f = np.broadcast_to((L_arr // 2)[:, None], (Z, MUT_CHUNK))
+        d_end_f = d_pos_f + 1
+        d_pos_r = np.broadcast_to((L_arr - L_arr // 2 - 1)[:, None],
+                                  (Z, MUT_CHUNK))
 
         # dispatch every chunk before collecting any: the device works
         # through the queued programs while the host marshals ahead
         states = []
+        m = np.arange(MUT_CHUNK, dtype=np.int64)[None, :]
         for c in range(n_chunks):
             lo = c * MUT_CHUNK
-            pos_f = np.zeros((Z, MUT_CHUNK), np.int32)
-            end_f = np.ones((Z, MUT_CHUNK), np.int32)
-            mtype = np.full((Z, MUT_CHUNK), SUB, np.int32)
-            base_f = np.zeros((Z, MUT_CHUNK), np.int32)
-            pos_r = np.zeros((Z, MUT_CHUNK), np.int32)
-            base_r = np.zeros((Z, MUT_CHUNK), np.int32)
             valid = np.zeros((Z, MUT_CHUNK), bool)
-            # default dummies sit mid-template to stay interior & cheap
-            for z in range(self.n_zmws):
-                L = len(self.tpls[z])
-                pos_f[z], end_f[z] = L // 2, L // 2 + 1
-                pos_r[z] = L - (L // 2) - 1
-                a, rc = arrs[z], rcs[z]
-                n = min(max(a.size - lo, 0), MUT_CHUNK)
-                if n:
-                    sl = slice(lo, lo + n)
-                    pos_f[z, :n] = a.start[sl]
-                    end_f[z, :n] = a.end[sl]
-                    mtype[z, :n] = a.mtype[sl]
-                    base_f[z, :n] = a.new_base[sl]
-                    pos_r[z, :n] = rc.start[sl]
-                    base_r[z, :n] = rc.new_base[sl]
-                    valid[z, :n] = True
-            states.append(self._dispatch_chunk(pos_f, end_f, mtype, base_f,
-                                               pos_r, base_r, valid))
+            valid[: self.n_zmws] = (lo + m) < sizes[:, None]
+            gidx = np.zeros((Z, MUT_CHUNK), np.int64)
+            gidx[: self.n_zmws] = np.minimum(
+                offs[:-1, None] + lo + m, offs[1:, None] - 1)
+            gidx = np.clip(gidx, 0, max(offs[-1] - 1, 0))
+            pick = lambda flat, dflt: np.where(
+                valid, flat[gidx], dflt) if len(flat) else \
+                np.broadcast_to(dflt, (Z, MUT_CHUNK)).copy()
+            states.append(self._dispatch_chunk(
+                pick(flat_pos_f, d_pos_f).astype(np.int32),
+                pick(flat_end_f, d_end_f).astype(np.int32),
+                pick(flat_mtype, SUB).astype(np.int32),
+                pick(flat_base_f, 0).astype(np.int32),
+                pick(flat_pos_r, d_pos_r).astype(np.int32),
+                pick(flat_base_r, 0).astype(np.int32),
+                valid))
 
         # one stacked fetch for the whole call: every device->host transfer
         # over the tunneled link costs ~0.1-0.25 s regardless of payload
         stacked = device_fetch(_stack_chunks(states), np.float64)
-        for c in range(n_chunks):
-            lo = c * MUT_CHUNK
-            for z in range(self.n_zmws):
-                n = min(max(arrs[z].size - lo, 0), MUT_CHUNK)
-                if n > 0:
-                    out[z][lo: lo + n] = stacked[c, z, :n]
+        out = []
+        for z in range(self.n_zmws):
+            # (C, M) row view -> one contiguous copy of this ZMW's scores
+            out.append(np.ascontiguousarray(
+                stacked[:, z, :]).reshape(-1)[: arrs[z].size])
         return out
 
     def score_mutations(self, muts_per_zmw: Sequence[Sequence[mutlib.Mutation]]
@@ -719,6 +763,7 @@ class BatchPolisher:
                         ) -> None:
         """Splice per-ZMW mutations, remap read windows, rebuild fills."""
         changed: list[int] = []
+        self._tpl_lengths_cache = None
         for z, best in enumerate(best_per_zmw):
             if not best:
                 continue

@@ -1,0 +1,107 @@
+"""Host-marshalling scale behavior (VERDICT round-1 item 10).
+
+At the 150k-ZMW streamed config the host must not serialize on Python
+per-(chunk, ZMW) loops while marshalling mutation batches.  These tests
+drive BatchPolisher.score_mutation_arrays' marshalling at Z=1024 with the
+device dispatch stubbed out, asserting (a) routing correctness of the
+vectorized ragged->dense packing/unpacking against a hand-computed
+expectation and (b) that marshalling cost stays in linear, sub-second
+territory.  Device compute at scale is exercised separately by bench.py
+(the real chip) -- compiling Z=1024 CPU programs in CI is minutes of
+XLA time and tests nothing about marshalling.
+"""
+
+import time
+
+import numpy as np
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.parallel.batch import MUT_CHUNK, BatchPolisher
+
+
+class _FakePolisher:
+    """Duck-typed stand-in carrying only what score_mutation_arrays uses."""
+
+    def __init__(self, tpls, Z):
+        self.tpls = tpls
+        self.n_zmws = len(tpls)
+        self._Z = Z
+        self.dispatched = []
+
+    def _dispatch_chunk(self, pos_f, end_f, mtype, base_f, pos_r, base_r,
+                        valid):
+        # scores encode (chunk, z, m) so unpack routing is fully checkable
+        c = len(self.dispatched)
+        Z, M = pos_f.shape
+        assert M == MUT_CHUNK
+        self.dispatched.append(
+            {k: v.copy() for k, v in dict(
+                pos_f=pos_f, valid=valid, mtype=mtype).items()})
+        z = np.arange(Z)[:, None]
+        m = np.arange(M)[None, :]
+        return (c * 1_000_000 + z * 1_000 + m).astype(np.float64)
+
+    score_mutation_arrays = BatchPolisher.score_mutation_arrays
+    score_mutations = BatchPolisher.score_mutations
+    _tpl_lengths = BatchPolisher._tpl_lengths
+
+
+def _mixed_tasks(rng, Z):
+    tpls = [rng.integers(0, 4, 32 + int(rng.integers(0, 33))).astype(np.int8)
+            for _ in range(Z)]
+    return tpls
+
+
+def test_marshalling_routing_exact(rng):
+    Z = 64
+    tpls = _mixed_tasks(rng, Z)
+    fake = _FakePolisher(tpls, Z)
+    arrs = [mutlib.enumerate_unique_arrays(t) for t in tpls]
+    out = fake.score_mutation_arrays(arrs)
+
+    for z, a in enumerate(arrs):
+        assert len(out[z]) == a.size
+        for m in (0, a.size // 2, a.size - 1):
+            c, rem = divmod(m, MUT_CHUNK)
+            assert out[z][m] == c * 1_000_000 + z * 1_000 + rem
+
+    # dispatched chunk contents match the ragged sources
+    for z, a in enumerate(arrs):
+        n0 = min(a.size, MUT_CHUNK)
+        d = fake.dispatched[0]
+        np.testing.assert_array_equal(d["pos_f"][z, :n0], a.start[:n0])
+        np.testing.assert_array_equal(d["valid"][z, :n0], True)
+        assert not d["valid"][z, n0:].any()
+
+
+def test_marshalling_scales_to_1024_zmws(rng):
+    Z = 1024
+    tpls = _mixed_tasks(rng, Z)
+    fake = _FakePolisher(tpls, Z)
+    arrs = [mutlib.enumerate_unique_arrays(t) for t in tpls]
+
+    t0 = time.monotonic()
+    out = fake.score_mutation_arrays(arrs)
+    marshal_s = time.monotonic() - t0
+
+    assert all(len(out[z]) == arrs[z].size for z in range(Z))
+    # vectorized marshalling: one pass over Z + pure-slice chunk dispatch.
+    # Measured ~0.05s; 2s leaves two orders of headroom on slow CI hosts
+    # while still failing hard if the per-(chunk, Z) loop returns.
+    assert marshal_s < 2.0, f"marshalling took {marshal_s:.2f}s at Z={Z}"
+
+    # memory of the dense marshalling arrays stays linear in Z x Mpad
+    mpad = len(fake.dispatched) * MUT_CHUNK
+    assert mpad * Z * 4 * 7 < 64e6  # ~7 int32 planes
+
+
+def test_marshalling_empty_and_ragged_edges(rng):
+    Z = 8
+    tpls = _mixed_tasks(rng, Z)
+    fake = _FakePolisher(tpls, Z)
+    arrs = [mutlib.enumerate_unique_arrays(t) for t in tpls]
+    empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
+    arrs[3] = empty                     # one ZMW with no mutations
+    out = fake.score_mutation_arrays(arrs)
+    assert len(out[3]) == 0
+    assert all(len(out[z]) == arrs[z].size for z in range(Z))
