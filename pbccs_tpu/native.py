@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -132,11 +133,10 @@ class NativePoa:
         self._lib = lib
         self._h = lib.pbccs_poa_new()
         self.n_reads = 0
-
-    def __del__(self):
-        h, self._h = getattr(self, "_h", None), None
-        if h:
-            self._lib.pbccs_poa_free(h)
+        # weakref.finalize rather than __del__: at interpreter shutdown the
+        # ctypes machinery may already be torn down, making a __del__-based
+        # free raise noisy ignored exceptions
+        self._finalizer = weakref.finalize(self, lib.pbccs_poa_free, self._h)
 
     def orient_add(self, read: np.ndarray, min_score: float = 0.0):
         """(path, reverse_complemented) or None when rejected."""

@@ -879,7 +879,13 @@ class BatchPolisher:
         return out
 
     def global_zscores(self) -> np.ndarray:
-        """(Z,) z-score of the summed log-likelihood per ZMW."""
+        """(Z,) z-score of the summed log-likelihood per ZMW.
+
+        Reports DRAFT-template statistics: baselines/active are AddRead-time
+        host snapshots by design (refinement rounds keep their updates on
+        device; see _setup), so calling this after refine() still describes
+        the pre-refinement template -- which is what the pipeline reports,
+        matching the serial path and the reference's draft-time ZScores."""
         out = np.full(self.n_zmws, np.nan)
         for z in range(self.n_zmws):
             act = self.active[z]

@@ -1,0 +1,98 @@
+"""Off-model noise robustness (round-1 weak item: all accuracy claims
+rested on reads sampled from the model itself).
+
+Two model-mismatched read corruptions the Arrow HMM does not generate:
+bursty error clusters (local stretches of garbage, e.g. polymerase
+stalls) and systematic homopolymer lengthening (a real PacBio bias).
+The refinement must not diverge on such input: the pipeline completes,
+tallies are sane, and the consensus stays near the truth -- degraded
+gracefully, not catastrophically.
+"""
+
+import numpy as np
+
+from pbccs_tpu.align.pairwise import align as nw_align
+from pbccs_tpu.models.arrow.params import decode_bases, revcomp
+from pbccs_tpu.pipeline import Chunk, Failure, Subread, process_chunks
+from pbccs_tpu.simulate import simulate_zmw
+
+
+def _aligned_accuracy(seq: str, truth_codes: np.ndarray) -> float:
+    fwd = nw_align(seq, decode_bases(truth_codes)).accuracy
+    rev = nw_align(seq, decode_bases(revcomp(truth_codes))).accuracy
+    return max(fwd, rev)
+
+
+def _add_bursts(rng, read: np.ndarray, n_bursts: int = 2) -> np.ndarray:
+    """Replace n short windows with random garbage and insert a few extra
+    bases -- error clusters no HMM pass structure explains."""
+    out = read.copy()
+    for _ in range(n_bursts):
+        if len(out) < 20:
+            break
+        pos = int(rng.integers(5, len(out) - 10))
+        blen = int(rng.integers(3, 7))
+        out[pos: pos + blen] = rng.integers(0, 4, blen)
+        ins = rng.integers(0, 4, int(rng.integers(1, 4))).astype(np.int8)
+        out = np.concatenate([out[:pos], ins, out[pos:]])
+    return out
+
+
+def _lengthen_homopolymers(rng, read: np.ndarray, p: float = 0.3) -> np.ndarray:
+    """Duplicate a base after each homopolymer run with probability p."""
+    parts = []
+    i = 0
+    while i < len(read):
+        j = i
+        while j < len(read) and read[j] == read[i]:
+            j += 1
+        parts.append(read[i:j])
+        if j - i >= 2 and rng.random() < p:
+            parts.append(read[i:i + 1])
+        i = j
+    return np.concatenate(parts)
+
+
+def test_bursty_reads_converge_gracefully(rng):
+    chunks, truths = [], []
+    for z in range(3):
+        tpl, reads, strands, snr = simulate_zmw(rng, 250, 8)
+        noisy = [_add_bursts(rng, r) for r in reads]
+        chunks.append(Chunk(f"burst/{z}",
+                            [Subread(f"burst/{z}/{i}", r)
+                             for i, r in enumerate(noisy)], snr))
+        truths.append(tpl)
+    tally = process_chunks(chunks)
+    assert sum(tally.counts.values()) == 3     # every ZMW tallied once
+    assert tally.counts[Failure.SUCCESS] >= 2  # bursts must not sink yield
+    for res in tally.results:
+        z = int(res.id.split("/")[1])
+        acc = _aligned_accuracy(res.sequence, truths[z])
+        # bursts land at independent positions per read, so consensus
+        # stays near truth; catastrophic divergence would crater this
+        assert acc > 0.95, (res.id, acc)
+        assert 0.5 < res.predicted_accuracy <= 1.0
+
+
+def test_homopolymer_bias_degrades_gracefully(rng):
+    chunks, truths = [], []
+    for z in range(3):
+        tpl, reads, strands, snr = simulate_zmw(rng, 250, 8)
+        noisy = [_lengthen_homopolymers(rng, r) for r in reads]
+        chunks.append(Chunk(f"hp/{z}",
+                            [Subread(f"hp/{z}/{i}", r)
+                             for i, r in enumerate(noisy)], snr))
+        truths.append(tpl)
+    tally = process_chunks(chunks)
+    assert sum(tally.counts.values()) == 3
+    assert tally.counts[Failure.SUCCESS] >= 2  # bias must not sink yield
+    # a systematic bias shared by every read CAN shift consensus bases at
+    # biased sites (the reference would too); the requirement is graceful
+    # degradation with the predicted accuracy honest about the damage
+    for res in tally.results:
+        z = int(res.id.split("/")[1])
+        acc = _aligned_accuracy(res.sequence, truths[z])
+        assert acc > 0.9, (res.id, acc)
+        # prediction must not be wildly overconfident versus realized
+        assert res.predicted_accuracy - acc < 0.1, (
+            res.id, res.predicted_accuracy, acc)
