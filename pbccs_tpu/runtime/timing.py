@@ -12,12 +12,14 @@ counters; reset() starts a measurement window.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
 
 _device_wait_s = 0.0
 _fetches = 0
+_lock = threading.Lock()  # fetches may come from concurrent batch workers
 
 
 def device_fetch(arr, dtype=None) -> np.ndarray:
@@ -25,8 +27,10 @@ def device_fetch(arr, dtype=None) -> np.ndarray:
     global _device_wait_s, _fetches
     t0 = time.perf_counter()
     out = np.asarray(arr, dtype) if dtype is not None else np.asarray(arr)
-    _device_wait_s += time.perf_counter() - t0
-    _fetches += 1
+    dt = time.perf_counter() - t0
+    with _lock:
+        _device_wait_s += dt
+        _fetches += 1
     return out
 
 
