@@ -1,0 +1,182 @@
+"""Device-resident refinement primitives: enumeration, selection, splice.
+
+The host lockstep refinement loop (parallel/batch.py refine) fetches the
+(Z, M) mutation scores every round to run selection and template splicing
+in numpy; over this environment's tunneled device link each fetch costs
+~0.1-0.25 s regardless of size, and the per-round fetch chain dominates
+polish wall time (profiled: ~80%).  These primitives re-express the
+host-side round logic as fixed-shape device ops so the whole refinement
+loop can run inside one jitted program (see batch.BatchPolisher.refine's
+device path), fetching once at the end.
+
+Parity targets (each pinned by tests/test_device_refine.py):
+  * slot_candidates == mutations.enumerate_unique_arrays (same candidate
+    set in the same pos-major order; rounds > 0 apply the same
+    center-window position filter as unique_nearby_arrays, though the
+    host's center-major candidate ORDER is not reproduced -- order only
+    matters for exact score ties);
+  * greedy_well_separated == mutations.best_subset (greedy max-score with
+    inclusive +-separation start exclusion; ties resolve to the earlier
+    candidate, matching the host's first-max rule in round 0);
+  * splice_templates == mutations.apply_mutations +
+    target_to_query_positions (the mtp map: mtp[j] = j - dels(<j) +
+    ins(<=j)).
+
+Candidate slot grid: position-major, 9 slots per template position in the
+host enumeration order (subs by base, ins by base, del); invalid slots are
+masked, never reordered, so slot index == candidate identity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pbccs_tpu.models.arrow.mutations import (DELETION, INSERTION,
+                                              SUBSTITUTION)
+
+N_SLOTS = 9
+# slot layout per position (mutations.py _SLOT_*): subs A,C,G,T; ins A,C,G,T;
+# del
+SLOT_BASES = np.array([0, 1, 2, 3, 0, 1, 2, 3, -1], np.int32)
+SLOT_TYPES = np.array([SUBSTITUTION] * 4 + [INSERTION] * 4 + [DELETION],
+                      np.int32)
+SLOT_ENDOFF = np.array([1, 1, 1, 1, 0, 0, 0, 0, 1], np.int32)
+
+_HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative constant
+
+
+def slot_candidates(tpl: jax.Array, tlen: jax.Array,
+                    allowed_pos: jax.Array | None = None):
+    """All unique single-base mutation candidates of one padded template.
+
+    Returns (start, end, mtype, new_base, valid), each (Jmax * 9,), in the
+    host enumeration order.  `allowed_pos` ((Jmax,) bool) restricts
+    candidate start positions (the nearby-window filter of rounds > 0)."""
+    Jmax = tpl.shape[0]
+    t = tpl.astype(jnp.int32)
+    prev = jnp.concatenate([jnp.array([-1], jnp.int32), t[:-1]])
+    pos = jnp.arange(Jmax, dtype=jnp.int32)
+
+    valid = jnp.zeros((Jmax, N_SLOTS), bool)
+    valid = valid.at[:, :4].set(SLOT_BASES[None, :4] != t[:, None])
+    valid = valid.at[:, 4:8].set(SLOT_BASES[None, 4:8] != prev[:, None])
+    valid = valid.at[:, 8].set(t != prev)
+    valid &= (pos < tlen)[:, None]
+    if allowed_pos is not None:
+        valid &= allowed_pos[:, None]
+
+    start = jnp.repeat(pos, N_SLOTS)
+    end = start + jnp.asarray(SLOT_ENDOFF)[None, :].repeat(Jmax, 0).reshape(-1)
+    mtype = jnp.tile(jnp.asarray(SLOT_TYPES), Jmax)
+    base = jnp.tile(jnp.asarray(SLOT_BASES), Jmax)
+    return start, end, mtype, base, valid.reshape(-1)
+
+
+def rc_candidates(start, end, base, tlen):
+    """Reverse-complement frame of the slot grid (mutations
+    reverse_complement_arrays): (start_r, base_r)."""
+    comp = jnp.where(base < 0, -1, 3 - base)
+    return tlen - end, comp
+
+
+def greedy_well_separated(scores: jax.Array, start: jax.Array,
+                          favorable: jax.Array, separation: int,
+                          jmax: int) -> jax.Array:
+    """(M,) bool taken-mask: greedy max-score subset with starts more than
+    `separation` apart (inclusive exclusion), ties to the earlier slot.
+
+    Scan over candidates in stable score-descending order carrying a
+    blocked-positions mask -- the device best_subset."""
+    M = scores.shape[0]
+    neg = jnp.where(favorable, -scores, jnp.inf)
+    order = jnp.argsort(neg, stable=True)  # score desc, slot-index ties
+
+    pos = jnp.arange(jmax, dtype=jnp.int32)
+
+    def step(carry, i):
+        blocked, taken = carry
+        cand = order[i]
+        s = start[cand]
+        ok = favorable[cand] & ~blocked[s]
+        window = (pos >= s - separation) & (pos <= s + separation) & ok
+        return (blocked | window, taken.at[cand].set(ok)), None
+
+    (blocked, taken), _ = lax.scan(
+        step, (jnp.zeros(jmax, bool), jnp.zeros(M, bool)),
+        jnp.arange(M))
+    return taken
+
+
+def splice_templates(tpl: jax.Array, tlen: jax.Array,
+                     start: jax.Array, mtype: jax.Array, base: jax.Array,
+                     taken: jax.Array):
+    """Apply a well-separated taken-set of single-base mutations.
+
+    Returns (new_tpl (Jmax,), new_tlen, mtp (Jmax+1,)) where mtp is the
+    old->new position map (target_to_query_positions).  Separation >= 1
+    guarantees at most one taken mutation per start position, so the edit
+    at each position is unique and the splice is two scatters."""
+    Jmax = tpl.shape[0]
+    pos = jnp.arange(Jmax, dtype=jnp.int32)
+
+    # per-position edit planes from the taken set
+    safe_start = jnp.clip(start, 0, Jmax - 1)
+    is_sub = taken & (mtype == SUBSTITUTION)
+    is_ins = taken & (mtype == INSERTION)
+    is_del = taken & (mtype == DELETION)
+    sub_at = jnp.zeros(Jmax, bool).at[safe_start].max(is_sub)
+    sub_base = jnp.zeros(Jmax, jnp.int32).at[safe_start].max(
+        jnp.where(is_sub, base, 0))
+    ins_at = jnp.zeros(Jmax + 1, bool).at[jnp.clip(start, 0, Jmax)].max(is_ins)
+    ins_base = jnp.zeros(Jmax + 1, jnp.int32).at[jnp.clip(start, 0, Jmax)].max(
+        jnp.where(is_ins, base, 0))
+    del_at = jnp.zeros(Jmax, bool).at[safe_start].max(is_del)
+
+    # mtp[j] = j - dels(start < j) + ins(start <= j)
+    dels_before = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(del_at.astype(jnp.int32))])
+    ins_upto = jnp.cumsum(ins_at.astype(jnp.int32))
+    mtp = jnp.arange(Jmax + 1, dtype=jnp.int32) - dels_before + ins_upto
+
+    new_tlen = mtp[tlen]
+
+    edited = jnp.where(sub_at, sub_base, tpl.astype(jnp.int32))
+    new_tpl = jnp.full(Jmax, 4, jnp.int32)
+    keep = (~del_at) & (pos < tlen)
+    dst = jnp.where(keep, mtp[:-1], Jmax)           # OOB drop for dels/pad
+    new_tpl = new_tpl.at[dst].set(edited, mode="drop")
+    ins_dst = jnp.where(ins_at & (jnp.arange(Jmax + 1) <= tlen),
+                        mtp - 1, Jmax)
+    new_tpl = new_tpl.at[ins_dst].set(ins_base, mode="drop")
+    return new_tpl.astype(tpl.dtype), new_tlen, mtp
+
+
+def template_hash(tpl: jax.Array, tlen: jax.Array) -> jax.Array:
+    """Rolling uint32 hash of the live template prefix (cycle detection)."""
+    Jmax = tpl.shape[0]
+    j = jnp.arange(Jmax, dtype=jnp.uint32)
+    powers = jnp.power(_HASH_MULT, j + 1)  # uint32 wraparound
+    live = (j < tlen.astype(jnp.uint32))
+    vals = jnp.where(live, tpl.astype(jnp.uint32) + 2, 0)
+    return (vals * powers).sum(dtype=jnp.uint32) ^ tlen.astype(jnp.uint32)
+
+
+def nearby_allowed(fav_start: jax.Array, fav_end: jax.Array,
+                   fav_mask: jax.Array, neighborhood: int,
+                   jmax: int) -> jax.Array:
+    """(Jmax,) bool: positions within `neighborhood` of any favorable
+    mutation's [start, end) -- the unique_nearby window filter.
+
+    Matches unique_nearby_arrays: each center m contributes candidate
+    starts in [m.start - n, m.end + n)."""
+    lo = jnp.where(fav_mask, jnp.maximum(fav_start - neighborhood, 0), jmax)
+    hi = jnp.where(fav_mask, jnp.minimum(fav_end + neighborhood, jmax), 0)
+    diff = jnp.zeros(jmax + 1, jnp.int32)
+    diff = diff.at[jnp.clip(lo, 0, jmax)].add(
+        jnp.where(fav_mask, 1, 0), mode="drop")
+    diff = diff.at[jnp.clip(hi, 0, jmax)].add(
+        jnp.where(fav_mask, -1, 0), mode="drop")
+    return jnp.cumsum(diff[:-1]) > 0

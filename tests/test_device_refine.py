@@ -1,0 +1,135 @@
+"""Parity of the device refinement primitives with the host numpy logic
+they re-express (see pbccs_tpu/parallel/device_refine.py docstring)."""
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.models.arrow import mutations as mutlib
+from pbccs_tpu.parallel import device_refine as dr
+
+
+def _host_candidates(tpl):
+    a = mutlib.enumerate_unique_arrays(tpl)
+    return set(zip(a.start.tolist(), a.mtype.tolist(), a.new_base.tolist()))
+
+
+def _dev_candidates(tpl, Jmax, allowed=None):
+    import jax.numpy as jnp
+
+    padded = np.full(Jmax, 4, np.int8)
+    padded[: len(tpl)] = tpl
+    s, e, t, b, v = dr.slot_candidates(
+        jnp.asarray(padded), jnp.int32(len(tpl)),
+        None if allowed is None else jnp.asarray(allowed))
+    s, e, t, b, v = (np.asarray(x) for x in (s, e, t, b, v))
+    return s, e, t, b, v
+
+
+def test_slot_candidates_match_host_enumeration(rng):
+    for _ in range(5):
+        tpl = rng.integers(0, 4, int(rng.integers(5, 60))).astype(np.int8)
+        s, e, t, b, v = _dev_candidates(tpl, 64)
+        dev = set(zip(s[v].tolist(), t[v].tolist(), b[v].tolist()))
+        assert dev == _host_candidates(tpl)
+        # ends consistent with types
+        host = mutlib.enumerate_unique_arrays(tpl)
+        dev_ends = {(st, mt, nb): en for st, en, mt, nb in
+                    zip(s[v], e[v], t[v], b[v])}
+        for st, en, mt, nb in zip(host.start, host.end, host.mtype,
+                                  host.new_base):
+            assert dev_ends[(int(st), int(mt), int(nb))] == int(en)
+
+
+def test_slot_candidates_nearby_filter(rng):
+    tpl = rng.integers(0, 4, 50).astype(np.int8)
+    centers = [mutlib.Mutation(10, 11, mutlib.SUBSTITUTION, 0),
+               mutlib.Mutation(30, 30, mutlib.INSERTION, 2)]
+    host = mutlib.unique_nearby_arrays(tpl, centers, 5)
+    want = set(zip(host.start.tolist(), host.mtype.tolist(),
+                   host.new_base.tolist()))
+
+    import jax.numpy as jnp
+
+    fav_start = jnp.asarray([10, 30], jnp.int32)
+    fav_end = jnp.asarray([11, 30], jnp.int32)
+    allowed = dr.nearby_allowed(fav_start, fav_end,
+                                jnp.asarray([True, True]), 5, 64)
+    allowed = np.asarray(allowed) & (np.arange(64) < len(tpl))
+    s, e, t, b, v = _dev_candidates(tpl, 64, allowed=allowed)
+    dev = set(zip(s[v].tolist(), t[v].tolist(), b[v].tolist()))
+    assert dev == want
+
+
+def test_greedy_matches_best_subset(rng):
+    import jax.numpy as jnp
+
+    for trial in range(8):
+        L = 60
+        tpl = rng.integers(0, 4, L).astype(np.int8)
+        s, e, t, b, v = _dev_candidates(tpl, 64)
+        scores = rng.normal(0, 3, len(s))
+        scores[~v] = -np.inf
+        fav = v & (scores > 0)
+
+        host_muts = [mutlib.Mutation(int(s[i]), int(e[i]), int(t[i]),
+                                     int(b[i]), float(scores[i]))
+                     for i in np.nonzero(fav)[0]]
+        want = mutlib.best_subset(host_muts, 10)
+        want_keys = {(m.start, m.mtype, m.new_base) for m in want}
+
+        taken = np.asarray(dr.greedy_well_separated(
+            jnp.asarray(scores, jnp.float32), jnp.asarray(s),
+            jnp.asarray(fav), 10, 64))
+        got_keys = {(int(s[i]), int(t[i]), int(b[i]))
+                    for i in np.nonzero(taken)[0]}
+        assert got_keys == want_keys, trial
+
+
+def test_splice_matches_apply_mutations(rng):
+    import jax.numpy as jnp
+
+    for trial in range(8):
+        L = 50
+        Jmax = 64
+        tpl = rng.integers(0, 4, L).astype(np.int8)
+        s, e, t, b, v = _dev_candidates(tpl, Jmax)
+        scores = rng.normal(0, 3, len(s))
+        scores[~v] = -np.inf
+        fav = v & (scores > 0)
+        taken = np.asarray(dr.greedy_well_separated(
+            jnp.asarray(scores, jnp.float32), jnp.asarray(s),
+            jnp.asarray(fav), 10, Jmax))
+        muts = [mutlib.Mutation(int(s[i]), int(e[i]), int(t[i]), int(b[i]))
+                for i in np.nonzero(taken)[0]]
+        if not muts:
+            continue
+        want_tpl = mutlib.apply_mutations(tpl, muts)
+        want_mtp = mutlib.target_to_query_positions(muts, L)
+
+        padded = np.full(Jmax, 4, np.int8)
+        padded[:L] = tpl
+        new_tpl, new_tlen, mtp = dr.splice_templates(
+            jnp.asarray(padded), jnp.int32(L), jnp.asarray(s),
+            jnp.asarray(t), jnp.asarray(b), jnp.asarray(taken))
+        new_tpl, new_tlen, mtp = (np.asarray(x) for x in
+                                  (new_tpl, new_tlen, mtp))
+        assert new_tlen == len(want_tpl)
+        np.testing.assert_array_equal(new_tpl[:new_tlen], want_tpl)
+        np.testing.assert_array_equal(mtp[: L + 1], want_mtp)
+
+
+def test_template_hash_distinguishes(rng):
+    import jax.numpy as jnp
+
+    tpl = rng.integers(0, 4, 40).astype(np.int8)
+    pad = np.full(64, 4, np.int8)
+    pad[:40] = tpl
+    h0 = int(dr.template_hash(jnp.asarray(pad), jnp.int32(40)))
+    # single-base change, length change, and pad-content change
+    p2 = pad.copy()
+    p2[17] = (p2[17] + 1) % 4
+    assert int(dr.template_hash(jnp.asarray(p2), jnp.int32(40))) != h0
+    assert int(dr.template_hash(jnp.asarray(pad), jnp.int32(39))) != h0
+    p3 = pad.copy()
+    p3[50] = 0  # beyond tlen: must not affect the hash
+    assert int(dr.template_hash(jnp.asarray(p3), jnp.int32(40))) == h0
