@@ -34,16 +34,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pbccs_tpu.models.arrow.mutations import (DELETION, INSERTION,
-                                              SUBSTITUTION)
+from pbccs_tpu.models.arrow.mutations import (_SLOT_BASES, _SLOT_ENDOFF,
+                                              _SLOT_TYPES, DELETION,
+                                              INSERTION, SUBSTITUTION)
 
 N_SLOTS = 9
-# slot layout per position (mutations.py _SLOT_*): subs A,C,G,T; ins A,C,G,T;
-# del
-SLOT_BASES = np.array([0, 1, 2, 3, 0, 1, 2, 3, -1], np.int32)
-SLOT_TYPES = np.array([SUBSTITUTION] * 4 + [INSERTION] * 4 + [DELETION],
-                      np.int32)
-SLOT_ENDOFF = np.array([1, 1, 1, 1, 0, 0, 0, 0, 1], np.int32)
+# slot layout per position: the host enumeration's own tables (one source
+# of truth for the slot-index == candidate-identity contract)
+SLOT_BASES = _SLOT_BASES
+SLOT_TYPES = _SLOT_TYPES
+SLOT_ENDOFF = _SLOT_ENDOFF
 
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative constant
 
@@ -90,6 +90,8 @@ def greedy_well_separated(scores: jax.Array, start: jax.Array,
 
     Scan over candidates in stable score-descending order carrying a
     blocked-positions mask -- the device best_subset."""
+    if separation == 0:  # best_subset: no exclusion, keep every favorable
+        return favorable
     M = scores.shape[0]
     neg = jnp.where(favorable, -scores, jnp.inf)
     order = jnp.argsort(neg, stable=True)  # score desc, slot-index ties
@@ -118,7 +120,12 @@ def splice_templates(tpl: jax.Array, tlen: jax.Array,
     Returns (new_tpl (Jmax,), new_tlen, mtp (Jmax+1,)) where mtp is the
     old->new position map (target_to_query_positions).  Separation >= 1
     guarantees at most one taken mutation per start position, so the edit
-    at each position is unique and the splice is two scatters."""
+    at each position is unique and the splice is two scatters.
+
+    Capacity contract: new_tlen is returned UNCLAMPED; bases past Jmax are
+    dropped by the scatters, so the caller MUST treat new_tlen > Jmax as
+    an overflow (the loop sets its bail-to-host flag) rather than carry
+    the inconsistent (tpl, tlen) pair into another round."""
     Jmax = tpl.shape[0]
     pos = jnp.arange(Jmax, dtype=jnp.int32)
 

@@ -118,6 +118,35 @@ def test_splice_matches_apply_mutations(rng):
         np.testing.assert_array_equal(mtp[: L + 1], want_mtp)
 
 
+def test_rc_candidates_match_host(rng):
+    import jax.numpy as jnp
+
+    tpl = rng.integers(0, 4, 40).astype(np.int8)
+    s, e, t, b, v = _dev_candidates(tpl, 64)
+    host = mutlib.enumerate_unique_arrays(tpl)
+    host_rc = mutlib.reverse_complement_arrays(host, len(tpl))
+    want = {(int(st), int(mt), int(nb)): (int(rs), int(rb))
+            for st, mt, nb, rs, rb in zip(host.start, host.mtype,
+                                          host.new_base, host_rc.start,
+                                          host_rc.new_base)}
+    rs, rb = dr.rc_candidates(jnp.asarray(s), jnp.asarray(e),
+                              jnp.asarray(b), jnp.int32(len(tpl)))
+    rs, rb = np.asarray(rs), np.asarray(rb)
+    for i in np.nonzero(v)[0]:
+        assert want[(int(s[i]), int(t[i]), int(b[i]))] == \
+            (int(rs[i]), int(rb[i]))
+
+
+def test_greedy_separation_zero_keeps_all(rng):
+    import jax.numpy as jnp
+
+    scores = jnp.asarray([1.0, 2.0, 3.0])
+    start = jnp.asarray([5, 5, 6], jnp.int32)
+    fav = jnp.asarray([True, True, False])
+    taken = np.asarray(dr.greedy_well_separated(scores, start, fav, 0, 16))
+    np.testing.assert_array_equal(taken, [True, True, False])
+
+
 def test_template_hash_distinguishes(rng):
     import jax.numpy as jnp
 
