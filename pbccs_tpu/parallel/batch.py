@@ -17,6 +17,7 @@ mutation workload (their slots are masked, not recompiled away).
 
 from __future__ import annotations
 
+import os
 import dataclasses
 import functools
 from typing import Sequence
@@ -789,15 +790,178 @@ class BatchPolisher:
 
     # ------------------------------------------------------------- refinement
 
+    def refine_device(self, opts: RefineOptions | None = None,
+                      skip=None) -> list[RefineResult] | None:
+        """Device-resident refinement: the whole loop runs inside one
+        jitted lax.while_loop (parallel/device_refine.py) and the host
+        fetches ONCE at the end -- over the tunneled device link the host
+        loop's per-round fetch chain is ~80% of polish wall time.
+
+        Returns None when the loop bailed (template outgrew the bucket or
+        a tiny-window fallback pair appeared); the caller falls back to
+        the host loop.  Mesh runs use the host loop (the while-loop body
+        is not yet sharding-annotated)."""
+        from pbccs_tpu.parallel import device_refine as dr
+
+        if self.mesh is not None:
+            return None
+        opts = opts or RefineOptions()
+        if getattr(self, "_stale_fills", False):
+            # a previous refine's straggler continuation left the adopted
+            # fills at pre-continuation state for those rows; rebuild from
+            # the current (host) templates before refining again
+            self._setup(first=False)
+            self._stale_fills = False
+        self._sub_polishers = {}
+        Z, R, Jmax = self._Z, self._R, self._Jmax
+
+        tl, tlens = self._template_arrays()
+        done0 = np.zeros(Z, bool)
+        done0[self.n_zmws:] = True
+        for z in (skip or ()):
+            done0[z] = True
+        H = 48
+        st = dr.RefineLoopState(
+            tpl=jnp.asarray(tl), tlens=jnp.asarray(tlens),
+            tstarts=self._tstarts_dev, tends=self._tends_dev,
+            win_tpl=self.win_tpl, win_trans=self.win_trans,
+            wlens=self.wlens, alpha=self.alpha, beta=self.beta,
+            a_prefix=self.a_prefix, b_suffix=self.b_suffix,
+            baselines=self._baselines_dev, trans_f=self.trans_f,
+            tpl_r=self.tpl_r, trans_r=self.trans_r,
+            active=self._active_dev,
+            it=jnp.int32(0), done=jnp.asarray(done0),
+            converged=jnp.zeros(Z, bool),
+            iterations=jnp.zeros(Z, jnp.int32),
+            n_tested=jnp.zeros(Z, jnp.int32),
+            n_applied=jnp.zeros(Z, jnp.int32),
+            allowed=jnp.ones((Z, Jmax), bool),
+            history=jnp.zeros((Z, H), jnp.uint32),
+            hist_n=jnp.zeros(Z, jnp.int32),
+            overflow=jnp.asarray(False))
+
+        out = dr.run_refine_loop(
+            st, self._reads_dev, self._rlens_dev, self._strands_dev,
+            self._shard(self._host_tables), jnp.asarray(self._real_rows),
+            width=self._W, use_pallas=fills_use_pallas(),
+            max_iterations=opts.max_iterations,
+            separation=opts.mutation_separation,
+            neighborhood=opts.mutation_neighborhood,
+            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN)
+        # one stacked fetch of the scalar-ish outcome planes
+        summary = device_fetch(jnp.concatenate([
+            out.tlens[None].astype(jnp.int32),
+            out.converged[None].astype(jnp.int32),
+            out.iterations[None], out.n_tested[None], out.n_applied[None],
+            jnp.broadcast_to(out.overflow.astype(jnp.int32), (1, Z)),
+        ]), np.int64)
+        tlens_h, conv_h, iters_h, tested_h, applied_h, overflow_h = summary
+        if overflow_h[0]:
+            return None  # host loop re-runs from the polisher's last state
+
+        tpl_h = device_fetch(out.tpl, np.int8)
+        tse = device_fetch(jnp.stack([out.tstarts, out.tends]), np.int64)
+        for z in range(self.n_zmws):
+            self.tpls[z] = tpl_h[z, : tlens_h[z]].copy()
+        self._tstarts = tse[0].astype(np.int32)
+        self._tends = tse[1].astype(np.int32)
+        self._tpl_lengths_cache = None
+
+        # adopt the loop's final device state so the QV sweep reuses it
+        (self.win_tpl, self.win_trans, self.wlens, self.alpha, self.beta,
+         self.a_prefix, self.b_suffix) = (
+            out.win_tpl, out.win_trans, out.wlens, out.alpha, out.beta,
+            out.a_prefix, out.b_suffix)
+        self._baselines_dev = out.baselines
+        self._active_dev = out.active
+        self.trans_f, self.tpl_r, self.trans_r = (out.trans_f, out.tpl_r,
+                                                  out.trans_r)
+        self._tpl_dev = out.tpl
+        self._tpl32_dev = out.tpl.astype(jnp.int32)
+        self._tpl32_r_dev = out.tpl_r.astype(jnp.int32)
+        self._tstarts_dev = out.tstarts
+        self._tends_dev = out.tends
+        self._tlens_dev = out.tlens
+        self._tlens = tlens_h.astype(np.int32)
+
+        # skip/padding ZMWs start done and can never set converged on device
+        results = [RefineResult(converged=bool(conv_h[z]),
+                                n_tested=int(tested_h[z]),
+                                n_applied=int(applied_h[z]),
+                                iterations=int(iters_h[z]))
+                   for z in range(self.n_zmws)]
+
+        # Straggler continuation: the loop exits early once few ZMWs remain
+        # (full-width lockstep rounds for 1-2 cycling ZMWs would dominate,
+        # e.g. a 40-round budget); finish them in a compact small-Z
+        # sub-polisher whose own device loop runs tiny rounds fetch-free.
+        skipset = set(skip or ())
+        stragglers = [z for z in range(self.n_zmws)
+                      if z not in skipset and not results[z].converged
+                      and results[z].iterations < opts.max_iterations]
+        if stragglers and self.n_zmws > len(stragglers):
+            sub_tasks = []
+            for z in stragglers:
+                rows = np.nonzero(self._real_rows[z])[0]
+                sub_tasks.append(ZmwTask(
+                    f"straggler/{z}", self.tpls[z].copy(), self._snrs[z],
+                    [self._reads[z, r, : self._rlens[z, r]].copy()
+                     for r in rows],
+                    [int(self._strands[z, r]) for r in rows],
+                    [int(self._tstarts[z, r]) for r in rows],
+                    [int(self._tends[z, r]) for r in rows]))
+            # one static sub-budget (a compile variant per distinct
+            # "remaining" would defeat the executable cache); stragglers may
+            # get up to a fresh full budget -- benign deviation, the only
+            # ZMWs affected are would-be NonConvergent cyclers given more
+            # chances to converge
+            sub = BatchPolisher(sub_tasks, config=self.config)
+            # parent gating carries over; the sub-polisher must not re-gate
+            # (it sees mid-refinement templates, not the draft).  The live
+            # read-active mask is on device (host copy is the AddRead-time
+            # snapshot by design); fetch just the straggler rows.
+            act = device_fetch(out.active)
+            sub_active = np.zeros((sub._Z, sub._R), bool)
+            for i, z in enumerate(stragglers):
+                n = min(sub._R, self._R)
+                sub_active[i, :n] = act[z, :n]
+            sub._active_dev = sub._shard(sub_active, 1)
+            sub_res = sub.refine(opts)
+            for i, z in enumerate(stragglers):
+                self.tpls[z] = sub.tpls[i]
+                r = sub_res[i]
+                results[z] = RefineResult(
+                    converged=r.converged,
+                    n_tested=results[z].n_tested + r.n_tested,
+                    n_applied=results[z].n_applied + r.n_applied,
+                    iterations=results[z].iterations + r.iterations)
+                self._sub_polishers[z] = (sub, i)
+            self._tpl_lengths_cache = None
+            self._stale_fills = True  # parent fills for straggler rows are
+            # pre-continuation; a later refine() must rebuild (see above)
+        return results
+
     def refine(self, opts: RefineOptions | None = None,
                skip=None) -> list[RefineResult]:
         """Lockstep greedy refinement across the batch.
+
+        Single-device runs route through the device-resident loop
+        (refine_device: the whole loop in one program, one fetch) unless
+        PBCCS_DEVICE_REFINE=0; mesh runs and device-loop bails (template
+        outgrew the bucket, tiny-window fallback pair) use the host loop
+        below, whose behavior the device loop is parity-tested against.
 
         ZMW indices in `skip` take no part in refinement (their RefineResult
         stays non-converged): the pipeline excludes ZMWs that already failed
         a yield gate so their slots cost no mutation work and their templates
         cannot grow the bucket."""
         opts = opts or RefineOptions()
+        if self.mesh is None and os.environ.get(
+                "PBCCS_DEVICE_REFINE", "").strip().lower() not in (
+                "0", "false", "off", "no"):
+            results = self.refine_device(opts, skip)
+            if results is not None:
+                return results
         Z = self.n_zmws
         results = [RefineResult(converged=False) for _ in range(Z)]
         history: list[set[int]] = [set() for _ in range(Z)]
@@ -858,8 +1022,25 @@ class BatchPolisher:
     def consensus_qvs(self, skip=None) -> list[np.ndarray]:
         """Per-ZMW per-position QVs (parity: ConsensusQVs,
         Consensus-inl.hpp:277-297), one batched sweep.  ZMWs in `skip` get
-        empty QV arrays and cost no device work."""
-        skip = skip or ()
+        empty QV arrays and cost no device work.  ZMWs the device loop
+        finished in a straggler sub-polisher (refine_device) pull their QVs
+        from it -- the parent's fills for those slots are pre-continuation."""
+        skip = set(skip or ())
+        subs = getattr(self, "_sub_polishers", None) or {}
+        out = self._consensus_qvs_impl(skip | set(subs))
+        for sub in {id(s): s for s, _ in subs.values()}.values():
+            wanted = {i: z for z, (s, i) in subs.items()
+                      if s is sub and z not in skip}
+            if not wanted:
+                continue  # all delegated ZMWs are skipped: no sweep at all
+            sub_skip = {i for z, (s, i) in subs.items()
+                        if s is sub and z in skip}
+            sub_q = sub.consensus_qvs(skip=sub_skip)
+            for i, z in wanted.items():
+                out[z] = sub_q[i]
+        return out
+
+    def _consensus_qvs_impl(self, skip) -> list[np.ndarray]:
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
                 for z, t in enumerate(self.tpls[: self.n_zmws])]

@@ -29,6 +29,9 @@ masked, never reordered, so slot index == candidate identity.
 
 from __future__ import annotations
 
+import functools
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,8 +40,10 @@ from jax import lax
 from pbccs_tpu.models.arrow.mutations import (_SLOT_BASES, _SLOT_ENDOFF,
                                               _SLOT_TYPES, DELETION,
                                               INSERTION, SUBSTITUTION)
+from pbccs_tpu.ops.fwdbwd import BandedMatrix
 
 N_SLOTS = 9
+EDGE_BUDGET = 64  # packed edge-mutation slab width per scoring chunk
 # slot layout per position: the host enumeration's own tables (one source
 # of truth for the slot-index == candidate-identity contract)
 SLOT_BASES = _SLOT_BASES
@@ -169,6 +174,332 @@ def template_hash(tpl: jax.Array, tlen: jax.Array) -> jax.Array:
     live = (j < tlen.astype(jnp.uint32))
     vals = jnp.where(live, tpl.astype(jnp.uint32) + 2, 0)
     return (vals * powers).sum(dtype=jnp.uint32) ^ tlen.astype(jnp.uint32)
+
+
+class RefineLoopState(NamedTuple):
+    """Carry of the device-resident refinement while_loop.
+
+    Loop-constant read tensors (reads/rlens/strands/table) are closed over
+    by the jitted loop, not carried."""
+
+    tpl: jax.Array          # (Z, Jmax) int8 forward template
+    tlens: jax.Array        # (Z,) int32
+    tstarts: jax.Array      # (Z, R) int32 read windows (fwd frame)
+    tends: jax.Array
+    win_tpl: jax.Array      # per-read oriented windows + fills
+    win_trans: jax.Array
+    wlens: jax.Array
+    alpha: BandedMatrix     # leaves (Z, R, ...)
+    beta: BandedMatrix
+    a_prefix: jax.Array
+    b_suffix: jax.Array
+    baselines: jax.Array    # (Z, R)
+    trans_f: jax.Array      # (Z, Jmax, 4)
+    tpl_r: jax.Array        # (Z, Jmax) int8 reverse-complement template
+    trans_r: jax.Array
+    active: jax.Array       # (Z, R) bool
+    it: jax.Array           # () int32
+    done: jax.Array         # (Z,) bool
+    converged: jax.Array    # (Z,) bool
+    iterations: jax.Array   # (Z,) int32
+    n_tested: jax.Array     # (Z,) int32
+    n_applied: jax.Array    # (Z,) int32
+    allowed: jax.Array      # (Z, Jmax) bool candidate-position filter
+    history: jax.Array      # (Z, H) uint32 template-hash ring
+    hist_n: jax.Array       # (Z,) int32
+    overflow: jax.Array     # () bool: bail-to-host flag
+
+
+def _chunk_count(jmax: int, chunk: int) -> int:
+    return (jmax * N_SLOTS + chunk - 1) // chunk
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "width", "use_pallas", "max_iterations", "separation", "neighborhood",
+    "chunk", "min_fast_edge"))
+def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
+                    real_rows, *, width: int, use_pallas: bool,
+                    max_iterations: int, separation: int,
+                    neighborhood: int, chunk: int, min_fast_edge: int):
+    """The jitted device refinement loop: up to max_iterations rounds of
+    enumerate -> score -> select -> splice -> rebuild entirely on device
+    (lax.while_loop with early exit), so the host fetches once.  A
+    module-level jit keyed on shapes/statics: every BatchPolisher at the
+    same bucket shape shares one executable.
+
+    Semantics mirror BatchPolisher.refine's host loop (which mirrors the
+    reference AbstractRefineConsensus, Consensus-inl.hpp:160-245), with two
+    documented deviations: candidate ORDER in rounds > 0 is position-major
+    rather than the host's center-major (ties across distinct mutations
+    resolve differently -- same candidate set), and cycle detection uses a
+    48-deep rolling-hash ring rather than an unbounded exact set."""
+    from pbccs_tpu.models.arrow.params import (revcomp_padded,
+                                               template_transition_params)
+    from pbccs_tpu.models.arrow.scorer import (fill_alpha_beta_batch_zr,
+                                               oriented_window)
+    from pbccs_tpu.parallel import batch as batchmod
+
+    Z, R = reads.shape[:2]
+    Jmax = None  # bound at trace time from state.tpl
+
+    def rebuild(tpl, tlens, tstarts, tends, active):
+        def one_zmw(t, L, tb, st1, ts1, te1):
+            trans_f = template_transition_params(t, tb, L)
+            t_r = revcomp_padded(t, L)
+            trans_r = template_transition_params(t_r, tb, L)
+            win = jax.vmap(
+                lambda s, a, b: oriented_window(s, a, b, t, trans_f,
+                                                t_r, trans_r, L)
+            )(st1, ts1, te1)
+            return win + (trans_f, t_r, trans_r)
+
+        (win_tpl, win_trans, wlens, trans_f, tpl_r, trans_r) = jax.vmap(
+            one_zmw)(tpl, tlens, table, strands, tstarts, tends)
+        alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch_zr(
+            reads, rlens, win_tpl, win_trans, wlens, width, use_pallas)
+        active = batchmod._update_active.__wrapped__(
+            active, ll_a, ll_b, rlens, tstarts, tends)
+        return (win_tpl, win_trans, wlens, alpha, beta, apre, bsuf,
+                ll_b, trans_f, tpl_r, trans_r, active)
+
+    def score_all(st: RefineLoopState, start, end, mtype, base, valid):
+        """(Z, M) totals over all candidate slots, scanning fixed chunks;
+        also returns the tiny-window fallback flag.
+
+        Candidates are packed per ZMW (stable argsort puts each row's valid
+        slots first) so the live work of sparse rounds -- nearby windows
+        cover a small fraction of the slot grid after round 0 -- compacts
+        into the leading chunk(s) and the all-invalid tail chunks
+        short-circuit.  Scores scatter back to slot-grid layout."""
+        jmax = st.tpl.shape[1]
+        M = jmax * N_SLOTS
+        C = _chunk_count(jmax, chunk)
+        Mpad = C * chunk
+        pad = Mpad - M
+
+        pack = jnp.argsort(~valid, axis=1, stable=True)      # (Z, M)
+        gz = lambda a: jnp.take_along_axis(a, pack, axis=1)
+        gm = lambda a: jnp.take_along_axis(
+            jnp.broadcast_to(a[None, :], (Z, M)), pack, axis=1)
+        p_start, p_end = gm(start), gm(end)
+        p_mtype, p_base = gm(mtype), gm(base)
+        p_valid = gz(valid)
+
+        def padz(a, fill):
+            return jnp.pad(a, [(0, 0), (0, pad)], constant_values=fill)
+
+        cshape = lambda a: a.reshape(Z, C, chunk).transpose(1, 0, 2)
+        pos_f = cshape(padz(p_start, 0))
+        end_f = cshape(padz(p_end, 1))
+        mt = cshape(padz(p_mtype, SUBSTITUTION))
+        mb = cshape(padz(p_base, 0))
+        vz = cshape(padz(p_valid, False))
+
+        tpl32 = st.tpl.astype(jnp.int32)
+        tpl32_r = st.tpl_r.astype(jnp.int32)
+
+        def one_chunk(_, xs):
+            p1, e1, t1, b1, v1 = xs
+            # rounds > 0 restrict candidates to the nearby windows, which
+            # cluster in a few chunks: chunks with no valid candidate
+            # short-circuit (their scores are -inf-masked anyway), cutting
+            # most of the late-round interior compute the host loop avoids
+            # by shrinking its mutation arrays
+            return None, lax.cond(v1.any(),
+                                  lambda: _chunk_compute(p1, e1, t1, b1, v1),
+                                  lambda: (jnp.zeros((Z, chunk)),
+                                           jnp.asarray(False)))
+
+        def _chunk_compute(p1, e1, t1, b1, v1):
+            # p1/e1/t1/b1/v1 are (Z, chunk): per-ZMW packed candidates
+            mpos_f, mend_f, mtyp, mbase_f = p1, e1, t1, b1
+            mpos_r = st.tlens[:, None] - e1
+            mbase_r = jnp.where(b1 < 0, -1, 3 - b1)
+
+            # geometry classification (the host _dispatch_chunk logic)
+            ts = st.tstarts[:, :, None]
+            te = st.tends[:, :, None]
+            strand = strands[:, :, None]
+            ms, me = mpos_f[:, None, :], mend_f[:, None, :]
+            is_ins = (mtyp == INSERTION)[:, None, :]
+            overlap = jnp.where(is_ins, (ts <= me) & (ms <= te),
+                                (ts < me) & (ms < te))
+            p_w = jnp.where(strand == 0, ms - ts, te - me)
+            e_w = jnp.where(strand == 0, me - ts, te - ms)
+            wlen = te - ts
+            interior = (p_w >= 3) & (e_w <= wlen - 2)
+            geo = v1[:, None, :] & overlap & real_rows[:, :, None]
+            int_mask = geo & interior
+            edge_mask = geo & ~interior
+            fb = (edge_mask & (wlen < min_fast_edge)).any()
+
+            int_tot, _, _ = batchmod._batch_interior_totals.__wrapped__(
+                reads, rlens, strands, st.tstarts, st.tends,
+                st.win_tpl, st.win_trans, st.wlens,
+                st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
+                st.beta.vals, st.beta.offsets, st.beta.log_scales,
+                st.a_prefix, st.b_suffix, st.baselines,
+                tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
+                mpos_f, mend_f, mtyp, mbase_f, mpos_r, mbase_r,
+                int_mask, st.active)
+
+            # edge mutations are a handful per chunk (window boundaries):
+            # pack them to a fixed slab on device (stable argsort puts
+            # edge-active columns first) so the edge program runs at
+            # EDGE_BUDGET width, not the full chunk; budget overflow bails
+            # to the host loop
+            eb = EDGE_BUDGET
+            e_ok = edge_mask & (wlen >= min_fast_edge)
+            em_any = e_ok.any(axis=1)                       # (Z, chunk)
+            e_over = em_any.sum(axis=1).max() > eb
+            order = jnp.argsort(~em_any, axis=1, stable=True)[:, :eb]
+            packed = jnp.take_along_axis(em_any, order, axis=1)
+            g = lambda a: jnp.take_along_axis(a, order, axis=1)
+            ge_mask = jnp.take_along_axis(
+                e_ok, order[:, None, :].repeat(e_ok.shape[1], 1), axis=2)
+            edge_packed = batchmod._batch_edge_fast_totals.__wrapped__(
+                reads, rlens, strands, st.tstarts, st.tends,
+                st.win_tpl, st.win_trans, st.wlens,
+                st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
+                st.beta.vals, st.beta.offsets, st.beta.log_scales,
+                st.a_prefix, st.b_suffix, st.baselines,
+                tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
+                g(mpos_f), g(mend_f), g(mtyp), g(mbase_f),
+                g(mpos_r), g(mbase_r),
+                ge_mask, st.active)
+            zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
+            edge_tot = jnp.zeros_like(int_tot).at[zidx, order].add(
+                jnp.where(packed, edge_packed, 0.0))
+            return (int_tot + edge_tot, fb | e_over)
+
+        _, (totals, fbs) = lax.scan(one_chunk, None,
+                                    (pos_f, end_f, mt, mb, vz))
+        packed_totals = totals.transpose(1, 0, 2).reshape(Z, Mpad)[:, :M]
+        # scatter back to slot-grid layout
+        zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
+        out = jnp.zeros((Z, M)).at[zidx, pack].set(packed_totals)
+        return out, fbs.any()
+
+    def body(st: RefineLoopState) -> RefineLoopState:
+        jmax = st.tpl.shape[1]
+
+        # 1. candidates (slot geometry is ZMW-independent; validity is not)
+        start, end, mtype, base, _ = slot_candidates(
+            st.tpl[0], st.tlens[0])
+        valid = jax.vmap(
+            lambda t, L, al: slot_candidates(t, L, al)[4]
+        )(st.tpl, st.tlens, st.allowed)
+        valid &= ~st.done[:, None]
+
+        # 2. scores
+        totals, fb_any = score_all(st, start, end, mtype, base, valid)
+        scores = jnp.where(valid, totals, -jnp.inf)
+        favorable = valid & (scores > 0.0)
+        fav_any = favorable.any(axis=1)
+
+        iterations = st.iterations + (~st.done).astype(jnp.int32)
+        n_tested = st.n_tested + jnp.where(st.done, 0,
+                                           valid.sum(axis=1, dtype=jnp.int32))
+
+        newly_converged = (~st.done) & (~fav_any)
+        converged = st.converged | newly_converged
+        done_now = st.done | newly_converged
+
+        # 3. greedy selection + cycle trim
+        taken = jax.vmap(
+            lambda s, f: greedy_well_separated(s, start, f, separation, jmax)
+        )(scores.astype(jnp.float32), favorable & ~done_now[:, None])
+
+        def splice_z(t, L, tk):
+            return splice_templates(t, L, start, mtype, base, tk)
+
+        nxt_tpl, nxt_tlen, _ = jax.vmap(splice_z)(st.tpl, st.tlens, taken)
+        nxt_hash = jax.vmap(template_hash)(nxt_tpl, nxt_tlen)
+        seen = ((st.history == nxt_hash[:, None])
+                & (jnp.arange(st.history.shape[1])[None, :]
+                   < st.hist_n[:, None])).any(axis=1)
+        multi = taken.sum(axis=1) > 1
+        trim = seen & multi
+        top1 = jnp.argmax(jnp.where(taken, scores, -jnp.inf), axis=1)
+        taken = jnp.where(
+            trim[:, None],
+            jax.nn.one_hot(top1, taken.shape[1], dtype=bool) & taken,
+            taken)
+
+        # 4. history push (current template, pre-apply) where a round ran
+        cur_hash = jax.vmap(template_hash)(st.tpl, st.tlens)
+        pushing = (~st.done) & fav_any
+        slot = st.hist_n % st.history.shape[1]
+        history = jnp.where(
+            pushing[:, None],
+            st.history.at[jnp.arange(Z), slot].set(cur_hash),
+            st.history)
+        hist_n = st.hist_n + pushing.astype(jnp.int32)
+
+        # 5. apply
+        apply_mask = pushing
+        new_tpl, new_tlen, mtp = jax.vmap(splice_z)(st.tpl, st.tlens, taken)
+        tpl = jnp.where(apply_mask[:, None], new_tpl, st.tpl)
+        tlens = jnp.where(apply_mask, new_tlen, st.tlens)
+        n_applied = st.n_applied + jnp.where(
+            apply_mask, taken.sum(axis=1, dtype=jnp.int32), 0)
+
+        def remap(m, ts_row, te_row, L):
+            # host: mtp[clip(window, 0, old_L)]
+            return m[jnp.clip(ts_row, 0, L)], m[jnp.clip(te_row, 0, L)]
+
+        ts_new, te_new = jax.vmap(remap)(mtp, st.tstarts, st.tends, st.tlens)
+        tstarts = jnp.where(apply_mask[:, None], ts_new, st.tstarts)
+        tends = jnp.where(apply_mask[:, None], te_new, st.tends)
+
+        overflow = st.overflow | fb_any | \
+            (jnp.where(apply_mask, new_tlen, 0) + 2 > jmax).any()
+
+        # 6. rebuild fills against the updated templates (skipped entirely
+        # when no ZMW applied anything this round -- the final round of a
+        # converging batch)
+        same = (st.win_tpl, st.win_trans, st.wlens, st.alpha, st.beta,
+                st.a_prefix, st.b_suffix, st.baselines, st.trans_f,
+                st.tpl_r, st.trans_r, st.active)
+        (win_tpl, win_trans, wlens, alpha, beta, apre, bsuf, baselines,
+         trans_f, tpl_r, trans_r, active) = lax.cond(
+            apply_mask.any(),
+            lambda: rebuild(tpl, tlens, tstarts, tends, st.active),
+            lambda: same)
+
+        # 7. next round's nearby filter from this round's favorables
+        def allowed_z(fv):
+            return nearby_allowed(start, end, fv, neighborhood, jmax)
+
+        allowed = jnp.where(fav_any[:, None],
+                            jax.vmap(allowed_z)(favorable),
+                            st.allowed)
+
+        return RefineLoopState(
+            tpl=tpl, tlens=tlens, tstarts=tstarts, tends=tends,
+            win_tpl=win_tpl, win_trans=win_trans, wlens=wlens,
+            alpha=alpha, beta=beta, a_prefix=apre, b_suffix=bsuf,
+            baselines=baselines, trans_f=trans_f, tpl_r=tpl_r,
+            trans_r=trans_r, active=active,
+            it=st.it + 1, done=done_now, converged=converged,
+            iterations=iterations, n_tested=n_tested, n_applied=n_applied,
+            allowed=allowed, history=history, hist_n=hist_n,
+            overflow=overflow)
+
+    # Straggler early exit: each lockstep round costs full (Z, ...) compute
+    # whatever the active count, so once only a handful of ZMWs remain
+    # (e.g. one cycling toward the 40-round budget) the loop returns and
+    # the caller finishes them in a compact small-Z sub-batch instead of
+    # paying Z-wide rounds (batch.BatchPolisher.refine).  Z <= 32 has no
+    # early exit (threshold 0).
+    straggler_exit = reads.shape[0] // 32
+
+    def cond(st: RefineLoopState):
+        return ((st.it < max_iterations)
+                & ((~st.done).sum() > straggler_exit)
+                & ~st.overflow)
+
+    return lax.while_loop(cond, body, state)
 
 
 def nearby_allowed(fav_start: jax.Array, fav_end: jax.Array,

@@ -21,6 +21,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# The device-resident refinement loop compiles one lax.while_loop program
+# per (Z, R, Jmax, opts) shape; across the suite's many shapes that is
+# minutes of XLA time testing nothing new.  The host loop (the behavior
+# the device loop is parity-pinned against in test_device_refine.py) runs
+# by default; device-loop tests opt back in per-test.
+os.environ.setdefault("PBCCS_DEVICE_REFINE", "0")
+
 # persistent compilation cache: the batched polish programs take minutes to
 # compile on CPU; cached executables make repeat test runs fast
 from pbccs_tpu.runtime.cache import enable_compilation_cache  # noqa: E402

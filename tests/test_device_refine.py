@@ -147,6 +147,130 @@ def test_greedy_separation_zero_keeps_all(rng):
     np.testing.assert_array_equal(taken, [True, True, False])
 
 
+def test_device_loop_matches_host_loop(rng, monkeypatch):
+    """End-to-end: the device-resident while_loop refinement produces
+    bit-identical templates, QVs, and counters to the host loop."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    tasks = []
+    for z in range(4):
+        tpl, reads, strands, snr = simulate_zmw(rng, 80, 5)
+        draft = tpl.copy()
+        draft[40] = (draft[40] + 1) % 4
+        if z == 1:
+            draft = np.delete(draft, 20)
+        tasks.append(ZmwTask(f"d/{z}", draft, snr, reads, strands,
+                             [0] * 5, [len(draft)] * 5))
+    opts = RefineOptions(max_iterations=8)
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "0")
+    host = BatchPolisher(tasks)
+    rh = host.refine(opts)
+    qh = host.consensus_qvs()
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "1")
+    dev = BatchPolisher(tasks)
+    rd = dev.refine(opts)
+    qd = dev.consensus_qvs()
+
+    for z in range(4):
+        assert rh[z].converged == rd[z].converged
+        assert rh[z].iterations == rd[z].iterations
+        assert rh[z].n_applied == rd[z].n_applied
+        assert rh[z].n_tested == rd[z].n_tested
+        np.testing.assert_array_equal(host.tpls[z], dev.tpls[z])
+        np.testing.assert_array_equal(qh[z], qd[z])
+
+
+def test_device_loop_skip_and_empty(rng, monkeypatch):
+    """skip ZMWs stay untouched and non-converged through the device loop."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "1")
+    tasks = []
+    for z in range(2):
+        tpl, reads, strands, snr = simulate_zmw(rng, 60, 4)
+        draft = tpl.copy()
+        draft[30] = (draft[30] + 1) % 4
+        tasks.append(ZmwTask(f"s/{z}", draft, snr, reads, strands,
+                             [0] * 4, [len(draft)] * 4))
+    p = BatchPolisher(tasks)
+    before = p.tpls[1].copy()
+    res = p.refine(RefineOptions(max_iterations=6), skip={1})
+    assert res[0].converged
+    assert not res[1].converged
+    assert res[1].n_tested == 0 and res[1].n_applied == 0
+    np.testing.assert_array_equal(p.tpls[1], before)
+
+
+def test_straggler_continuation_plumbing(rng, monkeypatch):
+    """The straggler early-exit path: a ZMW the loop returns unconverged
+    with budget left is finished in a compact sub-polisher, its template
+    and counters merge into the parent's results, its QVs come from the
+    sub-polisher, and a second refine() is safe (stale-fill rebuild).
+
+    The early exit itself needs Z>=33 (threshold Z//32), too big to
+    compile in CI, so the loop's return is shimmed to mark one ZMW as an
+    early-exited straggler."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel import device_refine as dr
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "1")
+    tasks = []
+    for z in range(3):
+        tpl, reads, strands, snr = simulate_zmw(rng, 70, 5)
+        draft = tpl.copy()
+        draft[35] = (draft[35] + 1) % 4
+        tasks.append(ZmwTask(f"st/{z}", draft, snr, reads, strands,
+                             [0] * 5, [len(draft)] * 5))
+
+    real_loop = dr.run_refine_loop
+
+    def shim(state, *args, **kw):
+        out = real_loop(state, *args, **kw)
+        import jax.numpy as jnp
+
+        # pretend ZMW 1 exited early, unconverged with budget left
+        return out._replace(
+            converged=out.converged.at[1].set(False),
+            done=out.done.at[1].set(False),
+            iterations=out.iterations.at[1].set(1),
+            overflow=jnp.asarray(False))
+
+    monkeypatch.setattr(dr, "run_refine_loop", shim)
+    p = BatchPolisher(tasks)
+    res = p.refine(RefineOptions(max_iterations=6))
+    monkeypatch.setattr(dr, "run_refine_loop", real_loop)
+
+    assert getattr(p, "_sub_polishers", None) and 1 in p._sub_polishers
+    assert res[1].converged  # the sub-polisher finished it
+
+    # reference outcome: an unshimmed polisher over the same tasks
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "0")
+    want = BatchPolisher(tasks)
+    want.refine(RefineOptions(max_iterations=6))
+    wq = want.consensus_qvs()
+
+    np.testing.assert_array_equal(p.tpls[1], want.tpls[1])
+    q = p.consensus_qvs()
+    np.testing.assert_array_equal(q[1], wq[1])
+    # skipped stragglers cost no sub sweep and stay empty
+    q2 = p.consensus_qvs(skip={1})
+    assert len(q2[1]) == 0
+
+    # second refine on the parent is safe after the continuation
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "1")
+    res2 = p.refine(RefineOptions(max_iterations=4))
+    assert all(r.converged for r in res2)
+    np.testing.assert_array_equal(p.tpls[1], want.tpls[1])
+
+
 def test_template_hash_distinguishes(rng):
     import jax.numpy as jnp
 
