@@ -121,23 +121,113 @@ class EdnaEvaluator:
 
     def loglik(self) -> float:
         """Dense forward log-likelihood over the full move set (the Edna
-        counterpart of the Quiver dense oracle)."""
-        I, J = self.read_length(), self.template_length()
-        a = np.full((I + 1, J + 1), -np.inf)
-        a[0, 0] = 0.0
-        for j in range(J + 1):
-            for i in range(I + 1):
-                terms = []
-                if i == 0 and j == 0:
-                    continue
-                if i > 0 and j > 0:
-                    terms.append(a[i - 1, j - 1] + self.inc(i - 1, j - 1))
-                if i > 0 and j <= J:
-                    terms.append(a[i - 1, j] + self.extra(i - 1, min(j, J - 1)))
-                if j > 0:
-                    terms.append(a[i, j - 1] + self.delete(i, j - 1))
-                if i > 0 and j > 1:
-                    terms.append(a[i - 1, j - 2] + self.merge(i - 1, j - 2))
-                if terms:
-                    a[i, j] = np.logaddexp.reduce(terms)
-        return float(a[I, J])
+        counterpart of the Quiver dense oracle); shares the edna_fill
+        recursion so the oracle and the counts machinery cannot drift."""
+        alpha, _ = edna_fill(self)
+        return float(alpha[self.read_length(), self.template_length()])
+
+
+def _transition(ev: EdnaEvaluator, i: int, j1: int, j2: int,
+                obs: int) -> float:
+    """Log score of the model transition from (i*, j1) to j2 observing
+    `obs` (0 = dark, consuming no pulse; else consuming pulse i).  The ONE
+    definition of the move set, shared by edna_fill and edna_counts so the
+    posterior counts always partition the fill's total:
+
+      j1 -> j1+1 pulse: move (score_move);  dark: delete() (pin-aware)
+      j1 -> j1   pulse: stay (final column clamps params); dark: no move
+      j1 -> j1+2 pulse: merge() (match-gated);             dark: no move
+    """
+    J = ev.template_length()
+    if j2 == j1 + 1:
+        return ev.score_move(j1, j2, obs) if obs else ev.delete(i, j1)
+    if j2 == j1:
+        jj = min(j1, J - 1)
+        return ev.score_move(jj, jj, obs) if obs else -np.inf
+    if j2 == j1 + 2:
+        return ev.merge(i, j1) if obs else -np.inf
+    raise ValueError("moves advance the template by 0, 1 or 2")
+
+
+def edna_fill(ev: EdnaEvaluator) -> tuple[np.ndarray, np.ndarray]:
+    """Dense log-space alpha/beta for the Edna pair-HMM.
+
+    alpha[i, j] = log P(first i pulses consumed, positioned at template
+    column j); transitions INTO a column carry their emission (score_move
+    semantics), so beta[i, j] = log P(remaining pulses | at (i, j)) with
+    the arrival emission excluded -- exactly the decomposition
+    EdnaCounts.DoCount sums over (alpha(i,j1) + ScoreMove(j1,j2,obs) +
+    beta(i',j2))."""
+    I, J = ev.read_length(), ev.template_length()
+    obs = ev.channels
+    alpha = np.full((I + 1, J + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for j in range(J + 1):
+        for i in range(I + 1):
+            if i == 0 and j == 0:
+                continue
+            acc = -np.inf
+            if j >= 1 and i >= 1:          # move consuming a pulse
+                acc = np.logaddexp(acc, alpha[i - 1, j - 1]
+                                   + _transition(ev, i - 1, j - 1, j,
+                                                 int(obs[i - 1])))
+            if j >= 1:                     # move consuming a dark
+                acc = np.logaddexp(acc, alpha[i, j - 1]
+                                   + _transition(ev, i, j - 1, j, 0))
+            if i >= 1:                     # stay emitting a pulse
+                acc = np.logaddexp(acc, alpha[i - 1, j]
+                                   + _transition(ev, i - 1, j, j,
+                                                 int(obs[i - 1])))
+            if j >= 2 and i >= 1:          # merge (2-column move)
+                acc = np.logaddexp(acc, alpha[i - 1, j - 2]
+                                   + _transition(ev, i - 1, j - 2, j,
+                                                 int(obs[i - 1])))
+            alpha[i, j] = acc
+
+    beta = np.full((I + 1, J + 1), -np.inf)
+    beta[I, J] = 0.0
+    for j in range(J, -1, -1):
+        for i in range(I, -1, -1):
+            if i == I and j == J:
+                continue
+            acc = -np.inf
+            if j < J and i < I:
+                acc = np.logaddexp(acc, beta[i + 1, j + 1]
+                                   + _transition(ev, i, j, j + 1, int(obs[i])))
+            if j < J:
+                acc = np.logaddexp(acc, beta[i, j + 1]
+                                   + _transition(ev, i, j, j + 1, 0))
+            if i < I:
+                acc = np.logaddexp(acc, beta[i + 1, j]
+                                   + _transition(ev, i, j, j, int(obs[i])))
+            if j + 2 <= J and i < I:
+                acc = np.logaddexp(acc, beta[i + 1, j + 2]
+                                   + _transition(ev, i, j, j + 2, int(obs[i])))
+            beta[i, j] = acc
+    return alpha, beta
+
+
+def edna_counts(ev: EdnaEvaluator, alpha: np.ndarray, beta: np.ndarray,
+                j1: int, j2: int) -> np.ndarray:
+    """(5,) log-space posterior transition masses from template column j1 to
+    j2, split by observed channel (0 = dark) -- the training statistic of
+    the reference's EdnaCounts::DoCount (EdnaCounts.cpp:68-105):
+
+      results[0]    = logsum_i alpha(i, j1) + ScoreMove(j1, j2, 0)
+                                            + beta(i, j2)
+      results[base] = logsum_i alpha(i, j1) + ScoreMove(j1, j2, base)
+                                            + beta(i+1, j2)
+    """
+    I = ev.read_length()
+    results = np.full(5, -np.inf)
+    for i in range(I + 1):
+        results[0] = np.logaddexp(
+            results[0], alpha[i, j1] + _transition(ev, i, j1, j2, 0)
+            + beta[i, j2])
+    for i in range(I):
+        base = int(ev.channels[i])
+        results[base] = np.logaddexp(
+            results[base], alpha[i, j1] + _transition(ev, i, j1, j2, base)
+            + beta[i + 1, j2])
+    return results
+

@@ -133,3 +133,95 @@ def test_edna_scores_match_template():
     # the j1+2 move emits from template position j1+1 (base 2 here)
     assert ev.score_move(0, 2, 2) == pytest.approx(
         np.log((1 - 0.1) * 0.2 * p.move_dist(2, 2)))
+
+
+def test_edna_counts_partition_total_likelihood():
+    """EdnaCounts parity (reference EdnaCounts.cpp:68-105): with merges off,
+    every path crosses column j -> j+1 exactly once, so the 5 channel-split
+    transition masses logsum to the total forward likelihood at EVERY j,
+    and alpha/beta agree on that total."""
+    from pbccs_tpu.models.edna import edna_counts, edna_fill
+
+    p = EdnaModelParams(p_stay=(0.15, 0.1, 0.2, 0.12), p_merge=(0.0,) * 4,
+                        move_dists=tuple(
+                            [0.1, 0.6, 0.1, 0.1, 0.1,
+                             0.1, 0.1, 0.6, 0.1, 0.1,
+                             0.1, 0.1, 0.1, 0.6, 0.1,
+                             0.1, 0.1, 0.1, 0.1, 0.6]),
+                        stay_dists=tuple([0.2] * 20))
+    rng = np.random.default_rng(3)
+    tpl = rng.integers(1, 5, 12).astype(np.int32)
+    read = np.concatenate([tpl[:5], tpl[6:], [2]]).astype(np.int32)
+
+    ev = EdnaEvaluator(read, tpl, p)
+    alpha, beta = edna_fill(ev)
+    total = alpha[len(read), len(tpl)]
+    assert np.isfinite(total)
+    np.testing.assert_allclose(total, beta[0, 0], rtol=1e-9)
+
+    for j in range(len(tpl)):
+        counts = edna_counts(ev, alpha, beta, j, j + 1)
+        lse = np.logaddexp.reduce(counts)
+        np.testing.assert_allclose(lse, total, rtol=1e-9, atol=1e-9)
+
+
+def test_edna_counts_channel_split_is_consistent():
+    """Dark mass (results[0]) responds to the dark emission probability."""
+    from pbccs_tpu.models.edna import edna_counts, edna_fill
+
+    def params(dark):
+        row = [dark] + [(1.0 - dark) / 4] * 4
+        return EdnaModelParams(p_stay=(0.1,) * 4, p_merge=(0.0,) * 4,
+                               move_dists=tuple(row * 4),
+                               stay_dists=tuple([0.2] * 20))
+
+    tpl = np.asarray([1, 2, 3, 4, 1, 2], np.int32)
+    read = tpl.copy()
+    lo, hi = [], []
+    for dark in (0.02, 0.5):
+        ev = EdnaEvaluator(read, tpl, params(dark))
+        alpha, beta = edna_fill(ev)
+        c = edna_counts(ev, alpha, beta, 2, 3)
+        total = alpha[len(read), len(tpl)]
+        (lo if dark == 0.02 else hi).append(c[0] - total)
+    assert hi[0] > lo[0]  # more dark emission -> more dark transition mass
+
+
+def test_edna_fill_consistent_with_loglik_merges_on():
+    """alpha total == beta total == loglik() over the FULL move set
+    including match-gated merges and final-column stays (the two spots
+    where a fill can silently diverge from the dense oracle)."""
+    from pbccs_tpu.models.edna import edna_fill
+
+    p = EdnaModelParams(p_stay=(0.1, 0.15, 0.1, 0.2), p_merge=(0.3,) * 4,
+                        move_dists=tuple([0.1, 0.6, 0.1, 0.1, 0.1] * 4),
+                        stay_dists=tuple([0.2] * 20))
+    tpl = np.asarray([1, 1, 2, 3, 3, 4, 2, 1], np.int32)
+    read = np.asarray([1, 2, 3, 3, 4, 2, 1], np.int32)
+    ev = EdnaEvaluator(read, tpl, p)
+    alpha, beta = edna_fill(ev)
+    total = alpha[len(read), len(tpl)]
+    np.testing.assert_allclose(total, beta[0, 0], rtol=1e-9)
+    np.testing.assert_allclose(total, ev.loglik(), rtol=1e-9)
+
+
+def test_edna_counts_cut_partition_with_merges():
+    """With merges ON, every path crosses the cut between columns j and
+    j+1 through exactly one of {j->j+1, (j-1)->j+1 merge, j->j+2 merge},
+    so those three count vectors logsum to the total likelihood."""
+    from pbccs_tpu.models.edna import edna_counts, edna_fill
+
+    p = EdnaModelParams(p_stay=(0.1, 0.15, 0.1, 0.2), p_merge=(0.3,) * 4,
+                        move_dists=tuple([0.1, 0.6, 0.1, 0.1, 0.1] * 4),
+                        stay_dists=tuple([0.2] * 20))
+    tpl = np.asarray([1, 1, 2, 3, 3, 4, 2, 1], np.int32)
+    read = np.asarray([1, 2, 3, 3, 4, 2, 1], np.int32)
+    ev = EdnaEvaluator(read, tpl, p)
+    alpha, beta = edna_fill(ev)
+    total = alpha[len(read), len(tpl)]
+    for j in range(1, len(tpl) - 2):
+        cut = np.logaddexp.reduce(np.concatenate([
+            edna_counts(ev, alpha, beta, j, j + 1),
+            edna_counts(ev, alpha, beta, j - 1, j + 1),
+            edna_counts(ev, alpha, beta, j, j + 2)]))
+        np.testing.assert_allclose(cut, total, rtol=1e-9)
