@@ -122,7 +122,8 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
 
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
     run_times, wait_times = [], []
-    for _ in range(repeats):
+    eval_outputs = eval_truths = None
+    for rep in range(repeats):
         tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes,
                                     n_corruptions)
         timing.reset()
@@ -130,6 +131,12 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
         tpls, results, qvs = run_all(tasks)
         run_times.append(time.monotonic() - t0)
         wait_times.append(timing.device_wait_seconds())
+        if rep == 0:
+            # accuracy is scored on the FIRST timed repeat's draw: the rng
+            # stream position (seed 20260729, draw #2 after warmup) is the
+            # same for every BENCH_REPEATS value, so the figure is pinned
+            # and round-over-round comparable at zero extra polish cost
+            eval_outputs, eval_truths = (tpls, results, qvs), truths
     bench_s = float(np.median(run_times))
     # device-wait fraction of the median-closest run (sync points block on
     # dispatch + device execution + transfer; the remainder is host work).
@@ -138,10 +145,10 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
     pick = int(np.argmin(np.abs(np.asarray(run_times) - bench_s)))
     device_wait_fraction = wait_times[pick] / (run_times[pick] * workers)
 
+    tpls, results_eval, qvs = eval_outputs
     flops = _estimate_flops(n_zmws, tpl_len, n_passes,
-                            sum(r.n_tested for r in results), batch_size)
-
-    n_exact = sum(bool(np.array_equal(tpls[z], truths[z]))
+                            sum(r.n_tested for r in results_eval), batch_size)
+    n_exact = sum(bool(np.array_equal(tpls[z], eval_truths[z]))
                   for z in range(n_zmws))
     mean_qv = float(np.mean([q.mean() for q in qvs]))
     return {
@@ -149,6 +156,7 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
         "bench_s": bench_s,
         "bench_s_min": float(np.min(run_times)),
         "bench_s_max": float(np.max(run_times)),
+        "run_times_s": [round(t, 3) for t in run_times],
         "repeats": repeats,
         "device_wait_fraction": round(device_wait_fraction, 4),
         "est_fill_tflops": round(flops / 1e12, 4),
@@ -157,9 +165,11 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
         "n_zmws": n_zmws,
         "tpl_len": tpl_len,
         "n_passes": n_passes,
-        "converged": sum(r.converged for r in results),
+        "converged": sum(r.converged for r in results_eval),
         "exact_recoveries": n_exact,
         "mean_qv": mean_qv,
+        "accuracy_draw": "first timed repeat (seed 20260729 draw #2; "
+                         "repeat-count-invariant, round-comparable)",
     }
 
 
@@ -289,12 +299,23 @@ def main() -> None:
         if os.path.exists(BASELINE_FILE):
             with open(BASELINE_FILE) as f:
                 rec = json.load(f)
+        new_config = {"n_zmws": n_zmws, "tpl_len": tpl_len,
+                      "n_passes": n_passes, "n_corruptions": n_corr}
+        if rec.get("config") not in (None, new_config):
+            # the reference C++ number was measured on the OLD workload
+            # config; keeping it would make later vs_reference_cpp ratios
+            # compare across different workloads.  It must be re-measured
+            # (native/refbench/README.md) for the new config.
+            for k in ("reference_cpp_zmws_per_sec", "reference_cpp",
+                      "note_statistic"):  # note compares to the ref number
+                if rec.pop(k, None) is not None:
+                    print(f"bench: dropped stale {k} (was measured on "
+                          f"config {rec.get('config')}); re-record per "
+                          "native/refbench/README.md", file=sys.stderr)
         rec.update({"cpu_zmws_per_sec": stats["zmws_per_sec"],
                     "platform": platform,
                     "cpu_batch": batch_size,
-                    "config": {"n_zmws": n_zmws, "tpl_len": tpl_len,
-                               "n_passes": n_passes,
-                               "n_corruptions": n_corr}})
+                    "config": new_config})
         with open(BASELINE_FILE, "w") as f:
             json.dump(rec, f, indent=2)
         print(f"wrote {BASELINE_FILE}", file=sys.stderr)

@@ -17,6 +17,12 @@ import dataclasses
 import numpy as np
 
 
+def _log(x: float) -> float:
+    """log with an exact -inf at zero (a zero-probability move is a legal
+    score, not a RuntimeWarning)."""
+    return float(np.log(x)) if x > 0.0 else float("-inf")
+
+
 @dataclasses.dataclass(frozen=True)
 class EdnaModelParams:
     """pStay/pMerge per template base (4,), move/stay emission tables
@@ -73,7 +79,7 @@ class EdnaEvaluator:
         pm = (1.0 - ps) * self._p_merge(j)
         trans = 1.0 - ps - pm
         em = self.params.move_dist(self._tpl_base(j), int(self.channels[i]))
-        return float(np.log(trans * em))
+        return _log(trans * em)
 
     def delete(self, i: int, j: int) -> float:
         if (not self.pin_start and i == 0) or \
@@ -83,12 +89,12 @@ class EdnaEvaluator:
         pm = (1.0 - ps) * self._p_merge(j)
         trans = 1.0 - ps - pm
         em = self.params.move_dist(self._tpl_base(j), 0)
-        return float(np.log(trans * em))
+        return _log(trans * em)
 
     def extra(self, i: int, j: int) -> float:
         trans = self._p_stay(j)
         em = self.params.stay_dist(self._tpl_base(j), int(self.channels[i]))
-        return float(np.log(trans * em))
+        return _log(trans * em)
 
     def merge(self, i: int, j: int) -> float:
         """Merge move score, *including* the pulse emission so merge() and
@@ -103,7 +109,7 @@ class EdnaEvaluator:
         ps = self._p_stay(j)
         pm = (1.0 - ps) * self._p_merge(j)
         em = self.params.move_dist(self._tpl_base(j + 1), int(self.channels[i]))
-        return float(np.log(pm * em))
+        return _log(pm * em)
 
     def score_move(self, j1: int, j2: int, obs: int) -> float:
         """Transition+emission log score for moving template j1 -> j2 while
@@ -111,12 +117,12 @@ class EdnaEvaluator:
         ps = self._p_stay(j1)
         pm = (1.0 - ps) * self._p_merge(j1)
         if j1 == j2:
-            return float(np.log(ps * self.params.stay_dist(self._tpl_base(j1), obs)))
+            return _log(ps * self.params.stay_dist(self._tpl_base(j1), obs))
         if j1 + 1 == j2:
             trans = 1.0 - ps - pm
-            return float(np.log(trans * self.params.move_dist(self._tpl_base(j1), obs)))
+            return _log(trans * self.params.move_dist(self._tpl_base(j1), obs))
         if j1 + 2 == j2:
-            return float(np.log(pm * self.params.move_dist(self._tpl_base(j1 + 1), obs)))
+            return _log(pm * self.params.move_dist(self._tpl_base(j1 + 1), obs))
         raise ValueError("moves advance the template by 0, 1 or 2")
 
     def loglik(self) -> float:

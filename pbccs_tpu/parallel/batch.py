@@ -791,7 +791,8 @@ class BatchPolisher:
     # ------------------------------------------------------------- refinement
 
     def refine_device(self, opts: RefineOptions | None = None,
-                      skip=None) -> list[RefineResult] | None:
+                      skip=None, budget: int | None = None
+                      ) -> list[RefineResult] | None:
         """Device-resident refinement: the whole loop runs inside one
         jitted lax.while_loop (parallel/device_refine.py) and the host
         fetches ONCE at the end -- over the tunneled device link the host
@@ -806,6 +807,7 @@ class BatchPolisher:
         if self.mesh is not None:
             return None
         opts = opts or RefineOptions()
+        budget = opts.max_iterations if budget is None else budget
         if getattr(self, "_stale_fills", False):
             # a previous refine's straggler continuation left the adopted
             # fills at pre-continuation state for those rows; rebuild from
@@ -830,7 +832,12 @@ class BatchPolisher:
             baselines=self._baselines_dev, trans_f=self.trans_f,
             tpl_r=self.tpl_r, trans_r=self.trans_r,
             active=self._active_dev,
-            it=jnp.int32(0), done=jnp.asarray(done0),
+            # budget < max_iterations (a straggler continuation) starts the
+            # round counter at the rounds already spent: the static
+            # max_iterations bound is unchanged (one executable per shape)
+            # while the loop runs at most `budget` more rounds
+            it=jnp.int32(opts.max_iterations - budget),
+            done=jnp.asarray(done0),
             converged=jnp.zeros(Z, bool),
             iterations=jnp.zeros(Z, jnp.int32),
             n_tested=jnp.zeros(Z, jnp.int32),
@@ -898,8 +905,14 @@ class BatchPolisher:
         skipset = set(skip or ())
         stragglers = [z for z in range(self.n_zmws)
                       if z not in skipset and not results[z].converged
-                      and results[z].iterations < opts.max_iterations]
-        if stragglers and self.n_zmws > len(stragglers):
+                      and results[z].iterations < budget]
+        # stragglers share one iteration count by construction: the device
+        # loop is lockstep, a ZMW leaves it only by converging (which
+        # excludes it from `stragglers`), so every straggler ran every
+        # round up to the early exit -- max() == each straggler's count
+        sub_budget = (budget - max(results[z].iterations
+                                   for z in stragglers)) if stragglers else 0
+        if stragglers and sub_budget > 0 and self.n_zmws > len(stragglers):
             sub_tasks = []
             for z in stragglers:
                 rows = np.nonzero(self._real_rows[z])[0]
@@ -910,11 +923,11 @@ class BatchPolisher:
                     [int(self._strands[z, r]) for r in rows],
                     [int(self._tstarts[z, r]) for r in rows],
                     [int(self._tends[z, r]) for r in rows]))
-            # one static sub-budget (a compile variant per distinct
-            # "remaining" would defeat the executable cache); stragglers may
-            # get up to a fresh full budget -- benign deviation, the only
-            # ZMWs affected are would-be NonConvergent cyclers given more
-            # chances to converge
+            # the continuation carries the REMAINING round budget (total
+            # iterations across parent + sub match the host loop and the
+            # reference's single max_iterations bound); the static
+            # max_iterations stays the executable-cache key, the spent
+            # rounds ride in as the dynamic initial round counter
             sub = BatchPolisher(sub_tasks, config=self.config)
             # parent gating carries over; the sub-polisher must not re-gate
             # (it sees mid-refinement templates, not the draft).  The live
@@ -926,7 +939,7 @@ class BatchPolisher:
                 n = min(sub._R, self._R)
                 sub_active[i, :n] = act[z, :n]
             sub._active_dev = sub._shard(sub_active, 1)
-            sub_res = sub.refine(opts)
+            sub_res = sub.refine(opts, budget=sub_budget)
             for i, z in enumerate(stragglers):
                 self.tpls[z] = sub.tpls[i]
                 r = sub_res[i]
@@ -942,7 +955,7 @@ class BatchPolisher:
         return results
 
     def refine(self, opts: RefineOptions | None = None,
-               skip=None) -> list[RefineResult]:
+               skip=None, budget: int | None = None) -> list[RefineResult]:
         """Lockstep greedy refinement across the batch.
 
         Single-device runs route through the device-resident loop
@@ -954,12 +967,19 @@ class BatchPolisher:
         ZMW indices in `skip` take no part in refinement (their RefineResult
         stays non-converged): the pipeline excludes ZMWs that already failed
         a yield gate so their slots cost no mutation work and their templates
-        cannot grow the bucket."""
+        cannot grow the bucket.
+
+        `budget` caps the number of refinement rounds this call may run
+        (defaults to opts.max_iterations); a straggler continuation passes
+        its remaining rounds so parent + continuation together never exceed
+        the reference's single max_iterations bound."""
         opts = opts or RefineOptions()
+        if budget is None:
+            budget = opts.max_iterations
         if self.mesh is None and os.environ.get(
                 "PBCCS_DEVICE_REFINE", "").strip().lower() not in (
                 "0", "false", "off", "no"):
-            results = self.refine_device(opts, skip)
+            results = self.refine_device(opts, skip, budget=budget)
             if results is not None:
                 return results
         Z = self.n_zmws
@@ -971,7 +991,7 @@ class BatchPolisher:
             done[z] = True
 
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
-        for it in range(opts.max_iterations):
+        for it in range(budget):
             arrs: list[mutlib.MutationArrays] = []
             for z in range(Z):
                 if done[z]:

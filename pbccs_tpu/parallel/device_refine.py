@@ -17,7 +17,9 @@ Parity targets (each pinned by tests/test_device_refine.py):
     matters for exact score ties);
   * greedy_well_separated == mutations.best_subset (greedy max-score with
     inclusive +-separation start exclusion; ties resolve to the earlier
-    candidate, matching the host's first-max rule in round 0);
+    candidate, matching the host's first-max rule in round 0).  At
+    separation == 0 (unused by any caller) the device deviates: it keeps
+    at most one mutation per start (see the in-function comment);
   * splice_templates == mutations.apply_mutations +
     target_to_query_positions (the mtp map: mtp[j] = j - dels(<j) +
     ins(<=j)).
@@ -95,9 +97,22 @@ def greedy_well_separated(scores: jax.Array, start: jax.Array,
 
     Scan over candidates in stable score-descending order carrying a
     blocked-positions mask -- the device best_subset."""
-    if separation == 0:  # best_subset: no exclusion, keep every favorable
-        return favorable
     M = scores.shape[0]
+    if separation == 0:
+        # DOCUMENTED DEVIATION from the host at separation == 0 (a setting
+        # no caller uses; RefineOptions defaults to 10): host best_subset
+        # keeps every favorable and apply_mutations can apply several
+        # same-start edits, but splice_templates' scatters silently merge
+        # same-start edits, so the device keeps only the best-scoring
+        # favorable per start (ties to the earlier slot) rather than
+        # corrupt the template
+        seg = jnp.full(jmax, -jnp.inf).at[jnp.clip(start, 0, jmax - 1)].max(
+            jnp.where(favorable, scores, -jnp.inf))
+        is_best = favorable & (scores == seg[jnp.clip(start, 0, jmax - 1)])
+        slot = jnp.arange(M, dtype=jnp.int32)
+        first = jnp.full(jmax, M, jnp.int32).at[
+            jnp.clip(start, 0, jmax - 1)].min(jnp.where(is_best, slot, M))
+        return is_best & (slot == first[jnp.clip(start, 0, jmax - 1)])
     neg = jnp.where(favorable, -scores, jnp.inf)
     order = jnp.argsort(neg, stable=True)  # score desc, slot-index ties
 

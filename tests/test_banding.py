@@ -110,16 +110,21 @@ def test_long_insert_draft_scales():
     from pbccs_tpu.poa.sparse import SparsePoa
 
     def per_base(tpl_len):
-        rng = np.random.default_rng(11)
-        tpl, reads, strands, snr = simulate_zmw(rng, tpl_len, 6)
-        t0 = time.monotonic()
-        poa = SparsePoa()
-        for r in reads:
-            poa.orient_and_add_read(r)
-        css, _ = poa.find_consensus(2)
-        dt = time.monotonic() - t0
-        assert abs(len(css) - tpl_len) < tpl_len * 0.1
-        return dt / (tpl_len * len(reads))
+        # min over repeats: the 600bp denominator is a short run whose
+        # single-shot timing is noise-prone on a loaded CI host
+        best = np.inf
+        for _ in range(3):
+            rng = np.random.default_rng(11)
+            tpl, reads, strands, snr = simulate_zmw(rng, tpl_len, 6)
+            t0 = time.monotonic()
+            poa = SparsePoa()
+            for r in reads:
+                poa.orient_and_add_read(r)
+            css, _ = poa.find_consensus(2)
+            dt = time.monotonic() - t0
+            assert abs(len(css) - tpl_len) < tpl_len * 0.1
+            best = min(best, dt / (tpl_len * len(reads)))
+        return best
 
     short = per_base(600)
     long_ = per_base(8000)

@@ -137,14 +137,19 @@ def test_rc_candidates_match_host(rng):
             (int(rs[i]), int(rb[i]))
 
 
-def test_greedy_separation_zero_keeps_all(rng):
+def test_greedy_separation_zero_dedupes_per_start(rng):
+    """separation=0 keeps every favorable START but at most one mutation
+    per start (splice_templates' scatters silently merge same-start edits):
+    best score wins, ties to the earlier slot."""
     import jax.numpy as jnp
 
-    scores = jnp.asarray([1.0, 2.0, 3.0])
-    start = jnp.asarray([5, 5, 6], jnp.int32)
-    fav = jnp.asarray([True, True, False])
+    scores = jnp.asarray([1.0, 2.0, 3.0, 4.0, 4.0])
+    start = jnp.asarray([5, 5, 6, 7, 7], jnp.int32)
+    fav = jnp.asarray([True, True, False, True, True])
     taken = np.asarray(dr.greedy_well_separated(scores, start, fav, 0, 16))
-    np.testing.assert_array_equal(taken, [True, True, False])
+    # start 5: best of (1.0, 2.0) -> slot 1; start 6: not favorable;
+    # start 7: tie (4.0, 4.0) -> earlier slot 3
+    np.testing.assert_array_equal(taken, [False, True, False, True, False])
 
 
 def test_device_loop_matches_host_loop(rng, monkeypatch):
@@ -250,6 +255,9 @@ def test_straggler_continuation_plumbing(rng, monkeypatch):
 
     assert getattr(p, "_sub_polishers", None) and 1 in p._sub_polishers
     assert res[1].converged  # the sub-polisher finished it
+    # the continuation carries the REMAINING budget: parent spent 1 round,
+    # so total iterations can never exceed the single max_iterations bound
+    assert res[1].iterations <= 6
 
     # reference outcome: an unshimmed polisher over the same tasks
     monkeypatch.setenv("PBCCS_DEVICE_REFINE", "0")

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Component-level wall-clock attribution for the device-resident polish.
+
+Times each stage of the polish loop separately (block_until_ready between
+stages, median of repeats) so the round-3 kernel work attacks the measured
+bottleneck instead of a guessed one:
+
+  * setup          BatchPolisher(...) construction (windows + first fills)
+  * fill           one fill_alpha_beta_batch_zr over the (Z, R) grid --
+                   the per-round rebuild cost inside the loop
+  * loop[n]        run_refine_loop with max_iterations=n; the n=1 -> full
+                   slope separates per-round cost from fixed overhead
+  * qv             consensus_qvs sweep
+
+Usage: python tools/profile_polish.py [--repeats 5]
+Env: BENCH_ZMWS/BENCH_TPL_LEN/BENCH_PASSES/BENCH_CORRUPTIONS as bench.py.
+Writes a JSON summary to stdout (one line) and a human table to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def med_time(fn, repeats=3):
+    ts = []
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        fn()
+        ts.append(time.monotonic() - t0)
+    ts.sort()
+    return ts[len(ts) // 2], ts
+
+
+def main():
+    import numpy as np
+
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_tasks
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.models.arrow.scorer import (fill_alpha_beta_batch_zr,
+                                               fills_use_pallas)
+    from pbccs_tpu.parallel.batch import BatchPolisher
+
+    repeats = int(sys.argv[sys.argv.index("--repeats") + 1]) \
+        if "--repeats" in sys.argv else 3
+    Z = int(os.environ.get("BENCH_ZMWS", 128))
+    L = int(os.environ.get("BENCH_TPL_LEN", 300))
+    P = int(os.environ.get("BENCH_PASSES", 8))
+    NC = int(os.environ.get("BENCH_CORRUPTIONS", 2))
+    rng = np.random.default_rng(20260729)
+    out = {"platform": jax.devices()[0].platform, "Z": Z, "L": L, "P": P}
+
+    def fresh_tasks():
+        return build_tasks(np.random.default_rng(20260729), Z, L, P, NC)[0]
+
+    # ---- setup ----------------------------------------------------------
+    BatchPolisher(fresh_tasks())  # warmup/compile
+    t, _ = med_time(lambda: BatchPolisher(fresh_tasks()), repeats)
+    out["setup_s"] = round(t, 4)
+
+    p = BatchPolisher(fresh_tasks())
+
+    # ---- raw fill (the loop's per-round rebuild core) -------------------
+    use_pal = fills_use_pallas()
+    filled = jax.jit(
+        lambda: fill_alpha_beta_batch_zr(
+            p._reads_dev, p._rlens_dev, p.win_tpl, p.win_trans, p.wlens,
+            p._W, use_pal))
+
+    def run_fill():
+        jax.block_until_ready(filled())
+
+    run_fill()
+    t, _ = med_time(run_fill, repeats)
+    out["fill_zr_s"] = round(t, 4)
+
+    # ---- device loop at several round budgets ---------------------------
+    loop_s = {}
+    for iters in (1, 2, 4, 10):
+        def run_loop(iters=iters):
+            pp = BatchPolisher(fresh_tasks())
+            res = pp.refine(RefineOptions(max_iterations=iters))
+            assert res is not None
+        run_loop()  # compile at this static budget
+        t, ts = med_time(run_loop, repeats)
+        loop_s[iters] = round(t, 4)
+    out["refine_s_by_iters"] = loop_s
+    # per-round slope from the 2->10 segment (round counts actually run
+    # shrink as ZMWs converge; slope is still the right order)
+    out["per_round_slope_s"] = round((loop_s[10] - loop_s[2]) / 8, 4)
+
+    # ---- QV sweep -------------------------------------------------------
+    pp = BatchPolisher(fresh_tasks())
+    pp.refine(RefineOptions(max_iterations=10))
+    pp.consensus_qvs()
+    t, _ = med_time(lambda: pp.consensus_qvs(), repeats)
+    out["qv_sweep_s"] = round(t, 4)
+
+    # ---- one full polish for reference ----------------------------------
+    def full():
+        pp = BatchPolisher(fresh_tasks())
+        pp.refine(RefineOptions(max_iterations=10))
+        pp.consensus_qvs()
+    t, _ = med_time(full, repeats)
+    out["full_polish_s"] = round(t, 4)
+    out["zmws_per_sec"] = round(Z / t, 2)
+
+    hdr = f"{'stage':24s} {'seconds':>10s}"
+    print(hdr, file=sys.stderr)
+    for k, v in out.items():
+        print(f"{k:24s} {v!s:>10s}", file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
