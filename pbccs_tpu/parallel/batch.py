@@ -790,6 +790,50 @@ class BatchPolisher:
 
     # ------------------------------------------------------------- refinement
 
+    def _device_resident_enabled(self) -> bool:
+        """One source of truth for the device-resident-path gate (the
+        refinement loop and the QV sweep must agree): single-device runs
+        only, opt-out via PBCCS_DEVICE_REFINE=0/false/off/no."""
+        return self.mesh is None and os.environ.get(
+            "PBCCS_DEVICE_REFINE", "").strip().lower() not in (
+            "0", "false", "off", "no")
+
+    def _loop_state(self, skip=None, it0: int = 0):
+        """Assemble the device-resident loop/sweep state from the adopted
+        device tensors (parallel/device_refine.RefineLoopState)."""
+        from pbccs_tpu.parallel import device_refine as dr
+
+        Z, Jmax = self._Z, self._Jmax
+        tl, tlens = self._template_arrays()
+        done0 = np.zeros(Z, bool)
+        done0[self.n_zmws:] = True
+        for z in (skip or ()):
+            done0[z] = True
+        H = 48
+        return dr.RefineLoopState(
+            tpl=jnp.asarray(tl), tlens=jnp.asarray(tlens),
+            tstarts=self._tstarts_dev, tends=self._tends_dev,
+            win_tpl=self.win_tpl, win_trans=self.win_trans,
+            wlens=self.wlens, alpha=self.alpha, beta=self.beta,
+            a_prefix=self.a_prefix, b_suffix=self.b_suffix,
+            baselines=self._baselines_dev, trans_f=self.trans_f,
+            tpl_r=self.tpl_r, trans_r=self.trans_r,
+            active=self._active_dev,
+            # it0 > 0 (a straggler continuation) starts the round counter
+            # at the rounds already spent: the static max_iterations bound
+            # is unchanged (one executable per shape) while the loop runs
+            # at most the remaining rounds
+            it=jnp.int32(it0),
+            done=jnp.asarray(done0),
+            converged=jnp.zeros(Z, bool),
+            iterations=jnp.zeros(Z, jnp.int32),
+            n_tested=jnp.zeros(Z, jnp.int32),
+            n_applied=jnp.zeros(Z, jnp.int32),
+            allowed=jnp.ones((Z, Jmax), bool),
+            history=jnp.zeros((Z, H), jnp.uint32),
+            hist_n=jnp.zeros(Z, jnp.int32),
+            overflow=jnp.asarray(False))
+
     def refine_device(self, opts: RefineOptions | None = None,
                       skip=None, budget: int | None = None
                       ) -> list[RefineResult] | None:
@@ -817,35 +861,7 @@ class BatchPolisher:
         self._sub_polishers = {}
         Z, R, Jmax = self._Z, self._R, self._Jmax
 
-        tl, tlens = self._template_arrays()
-        done0 = np.zeros(Z, bool)
-        done0[self.n_zmws:] = True
-        for z in (skip or ()):
-            done0[z] = True
-        H = 48
-        st = dr.RefineLoopState(
-            tpl=jnp.asarray(tl), tlens=jnp.asarray(tlens),
-            tstarts=self._tstarts_dev, tends=self._tends_dev,
-            win_tpl=self.win_tpl, win_trans=self.win_trans,
-            wlens=self.wlens, alpha=self.alpha, beta=self.beta,
-            a_prefix=self.a_prefix, b_suffix=self.b_suffix,
-            baselines=self._baselines_dev, trans_f=self.trans_f,
-            tpl_r=self.tpl_r, trans_r=self.trans_r,
-            active=self._active_dev,
-            # budget < max_iterations (a straggler continuation) starts the
-            # round counter at the rounds already spent: the static
-            # max_iterations bound is unchanged (one executable per shape)
-            # while the loop runs at most `budget` more rounds
-            it=jnp.int32(opts.max_iterations - budget),
-            done=jnp.asarray(done0),
-            converged=jnp.zeros(Z, bool),
-            iterations=jnp.zeros(Z, jnp.int32),
-            n_tested=jnp.zeros(Z, jnp.int32),
-            n_applied=jnp.zeros(Z, jnp.int32),
-            allowed=jnp.ones((Z, Jmax), bool),
-            history=jnp.zeros((Z, H), jnp.uint32),
-            hist_n=jnp.zeros(Z, jnp.int32),
-            overflow=jnp.asarray(False))
+        st = self._loop_state(skip, it0=opts.max_iterations - budget)
 
         out = dr.run_refine_loop(
             st, self._reads_dev, self._rlens_dev, self._strands_dev,
@@ -976,9 +992,7 @@ class BatchPolisher:
         opts = opts or RefineOptions()
         if budget is None:
             budget = opts.max_iterations
-        if self.mesh is None and os.environ.get(
-                "PBCCS_DEVICE_REFINE", "").strip().lower() not in (
-                "0", "false", "off", "no"):
+        if self._device_resident_enabled():
             results = self.refine_device(opts, skip, budget=budget)
             if results is not None:
                 return results
@@ -1065,7 +1079,11 @@ class BatchPolisher:
         arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
                 for z, t in enumerate(self.tpls[: self.n_zmws])]
         skipped = [z in skip for z in range(self.n_zmws)]
-        scores = self.score_mutation_arrays(arrs)
+        scores = None
+        if self._device_resident_enabled():
+            scores = self._qv_scores_device(skip, arrs)
+        if scores is None:
+            scores = self.score_mutation_arrays(arrs)
         out = []
         for z in range(self.n_zmws):
             if skipped[z]:
@@ -1077,6 +1095,43 @@ class BatchPolisher:
             prob = 1.0 - 1.0 / (1.0 + ssum)
             prob = np.maximum(prob, np.finfo(float).tiny)
             out.append(np.round(-10.0 * np.log10(prob)).astype(np.int32))
+        return out
+
+    def _qv_scores_device(self, skip, arrs) -> list[np.ndarray] | None:
+        """QV-sweep slot-grid scores in ONE device program + one fetch.
+
+        The chunked host path (score_mutation_arrays) dispatches C programs
+        with numpy mask building between them -- ~1 s of wall for ~80 ms of
+        device compute on the bench workload.  Per-slot values are
+        identical (packing only reorders the chunk axis), so the host
+        aggregation downstream is unchanged.  Returns None when a
+        tiny-window fallback pair exists (the chunked path handles it)."""
+        from pbccs_tpu.parallel import device_refine as dr
+
+        st = self._loop_state(skip)
+        skip_mask = np.zeros(self._Z, bool)
+        skip_mask[self.n_zmws:] = True
+        for z in skip:
+            skip_mask[z] = True
+        packed, fb = dr.run_qv_grid(
+            st, self._reads_dev, self._rlens_dev, self._strands_dev,
+            self._shard(self._host_tables), jnp.asarray(self._real_rows),
+            jnp.asarray(skip_mask),
+            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN)
+        stacked = device_fetch(jnp.concatenate(
+            [packed, jnp.broadcast_to(fb.astype(packed.dtype),
+                                      (1, packed.shape[1]))], axis=0),
+            np.float64)
+        if stacked[-1, 0] > 0.5:
+            return None  # tiny-window fallback pair: chunked path handles
+        out = []
+        for z in range(self.n_zmws):
+            if skip_mask[z]:
+                out.append(np.zeros(0))
+                continue
+            # row z's leading entries are its valid-slot scores in host
+            # enumeration order (run_qv_grid packing contract)
+            out.append(stacked[z, : arrs[z].size])
         return out
 
     def global_zscores(self) -> np.ndarray:

@@ -89,14 +89,54 @@ def rc_candidates(start, end, base, tlen):
     return tlen - end, comp
 
 
+def _lex_window_max(sc, sl, separation: int):
+    """Windowed lexicographic max over positions: for each position p,
+    the (score desc, slot asc) best among positions [p-sep, p+sep].
+    2*sep static shift-combines (sep is small: default 10)."""
+    def shift(x, d, fill):
+        if d > 0:
+            return jnp.concatenate([x[d:], jnp.full(d, fill, x.dtype)])
+        return jnp.concatenate([jnp.full(-d, fill, x.dtype), x[:d]])
+
+    best_sc, best_sl = sc, sl
+    for d in range(1, separation + 1):
+        for s in (d, -d):
+            c_sc = shift(sc, s, -jnp.inf)
+            c_sl = shift(sl, s, jnp.iinfo(sl.dtype).max)
+            win = (c_sc > best_sc) | ((c_sc == best_sc) & (c_sl < best_sl))
+            best_sc = jnp.where(win, c_sc, best_sc)
+            best_sl = jnp.where(win, c_sl, best_sl)
+    return best_sc, best_sl
+
+
+def _window_or(mask, separation: int):
+    """positions within +-separation of any set position (static shifts)."""
+    out = mask
+    for d in range(1, separation + 1):
+        out = out | jnp.concatenate([mask[d:], jnp.zeros(d, bool)])
+        out = out | jnp.concatenate([jnp.zeros(d, bool), mask[:-d]])
+    return out
+
+
 def greedy_well_separated(scores: jax.Array, start: jax.Array,
                           favorable: jax.Array, separation: int,
                           jmax: int) -> jax.Array:
     """(M,) bool taken-mask: greedy max-score subset with starts more than
     `separation` apart (inclusive exclusion), ties to the earlier slot.
 
-    Scan over candidates in stable score-descending order carrying a
-    blocked-positions mask -- the device best_subset."""
+    Data-parallel local-max PEELING instead of an M-step sequential scan
+    (the scan's per-candidate scatter was ~7% of all device time in the
+    round-3 profile): each peel round simultaneously takes every live
+    candidate that is the lexicographic (score desc, slot asc) maximum
+    among live candidates within +-separation of its start, then blocks
+    their neighborhoods.  Winners of one round are mutually >separation
+    apart by construction (two winners within the window would each have
+    to lexicographically beat the other), and the result equals the
+    sequential greedy scan: a candidate survives to be taken iff it is
+    not dominated by a taken candidate in its window, which the peeling
+    resolves layer by layer.  Parity with the scan implementation is
+    pinned by tests/test_device_refine.py::test_greedy_peel_matches_scan.
+    """
     M = scores.shape[0]
     if separation == 0:
         # DOCUMENTED DEVIATION from the host at separation == 0 (a setting
@@ -113,6 +153,42 @@ def greedy_well_separated(scores: jax.Array, start: jax.Array,
         first = jnp.full(jmax, M, jnp.int32).at[
             jnp.clip(start, 0, jmax - 1)].min(jnp.where(is_best, slot, M))
         return is_best & (slot == first[jnp.clip(start, 0, jmax - 1)])
+
+    slot = jnp.arange(M, dtype=jnp.int32)
+    sstart = jnp.clip(start, 0, jmax - 1)
+    sc32 = scores.astype(jnp.float32)
+
+    def body(st):
+        taken, blocked, alive = st
+        live_sc = jnp.where(alive, sc32, -jnp.inf)
+        # per-position best live candidate: (max score, then min slot
+        # among the score-achievers) -- two scatters
+        pos_sc = jnp.full(jmax, -jnp.inf).at[sstart].max(live_sc)
+        hit = alive & (sc32 == pos_sc[sstart])
+        pos_sl = jnp.full(jmax, M, jnp.int32).at[sstart].min(
+            jnp.where(hit, slot, M))
+        win_sc, win_sl = _lex_window_max(pos_sc, pos_sl, separation)
+        winner = alive & (win_sl[sstart] == slot)
+        taken = taken | winner
+        win_pos = jnp.zeros(jmax, bool).at[sstart].max(winner)
+        blocked = blocked | _window_or(win_pos, separation)
+        alive = alive & ~winner & ~blocked[sstart]
+        return taken, blocked, alive
+
+    taken, _, _ = lax.while_loop(
+        lambda st: st[2].any(), body,
+        (jnp.zeros(M, bool), jnp.zeros(jmax, bool), favorable))
+    return taken
+
+
+def greedy_well_separated_scan(scores: jax.Array, start: jax.Array,
+                               favorable: jax.Array, separation: int,
+                               jmax: int) -> jax.Array:
+    """The original M-step sequential-scan greedy (kept as the parity
+    oracle for the peeling implementation; not used on the hot path)."""
+    M = scores.shape[0]
+    if separation == 0:
+        return greedy_well_separated(scores, start, favorable, 0, jmax)
     neg = jnp.where(favorable, -scores, jnp.inf)
     order = jnp.argsort(neg, stable=True)  # score desc, slot-index ties
 
@@ -229,6 +305,161 @@ def _chunk_count(jmax: int, chunk: int) -> int:
     return (jmax * N_SLOTS + chunk - 1) // chunk
 
 
+def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
+                    real_rows, start, end, mtype, base, valid, *,
+                    chunk: int, min_fast_edge: int):
+    """(Z, M) totals over all candidate slots, scanning fixed chunks;
+    also returns the tiny-window fallback flag.  Shared by the refinement
+    loop's per-round scoring and the one-dispatch QV sweep (run_qv_grid).
+
+    Candidates are packed per ZMW (stable argsort puts each row's valid
+    slots first) so the live work of sparse rounds -- nearby windows
+    cover a small fraction of the slot grid after round 0 -- compacts
+    into the leading chunk(s) and the all-invalid tail chunks
+    short-circuit.  Scores scatter back to slot-grid layout."""
+    from pbccs_tpu.parallel import batch as batchmod
+
+    Z = reads.shape[0]
+    jmax = st.tpl.shape[1]
+    M = jmax * N_SLOTS
+    C = _chunk_count(jmax, chunk)
+    Mpad = C * chunk
+    pad = Mpad - M
+
+    pack = jnp.argsort(~valid, axis=1, stable=True)      # (Z, M)
+    gz = lambda a: jnp.take_along_axis(a, pack, axis=1)
+    gm = lambda a: jnp.take_along_axis(
+        jnp.broadcast_to(a[None, :], (Z, M)), pack, axis=1)
+    p_start, p_end = gm(start), gm(end)
+    p_mtype, p_base = gm(mtype), gm(base)
+    p_valid = gz(valid)
+
+    def padz(a, fill):
+        return jnp.pad(a, [(0, 0), (0, pad)], constant_values=fill)
+
+    cshape = lambda a: a.reshape(Z, C, chunk).transpose(1, 0, 2)
+    pos_f = cshape(padz(p_start, 0))
+    end_f = cshape(padz(p_end, 1))
+    mt = cshape(padz(p_mtype, SUBSTITUTION))
+    mb = cshape(padz(p_base, 0))
+    vz = cshape(padz(p_valid, False))
+
+    tpl32 = st.tpl.astype(jnp.int32)
+    tpl32_r = st.tpl_r.astype(jnp.int32)
+
+    def one_chunk(_, xs):
+        p1, e1, t1, b1, v1 = xs
+        # rounds > 0 restrict candidates to the nearby windows, which
+        # cluster in a few chunks: chunks with no valid candidate
+        # short-circuit (their scores are -inf-masked anyway), cutting
+        # most of the late-round interior compute the host loop avoids
+        # by shrinking its mutation arrays
+        return None, lax.cond(v1.any(),
+                              lambda: _chunk_compute(p1, e1, t1, b1, v1),
+                              lambda: (jnp.zeros((Z, chunk)),
+                                       jnp.asarray(False)))
+
+    def _chunk_compute(p1, e1, t1, b1, v1):
+        # p1/e1/t1/b1/v1 are (Z, chunk): per-ZMW packed candidates
+        mpos_f, mend_f, mtyp, mbase_f = p1, e1, t1, b1
+        mpos_r = st.tlens[:, None] - e1
+        mbase_r = jnp.where(b1 < 0, -1, 3 - b1)
+
+        # geometry classification (the host _dispatch_chunk logic)
+        ts = st.tstarts[:, :, None]
+        te = st.tends[:, :, None]
+        strand = strands[:, :, None]
+        ms, me = mpos_f[:, None, :], mend_f[:, None, :]
+        is_ins = (mtyp == INSERTION)[:, None, :]
+        overlap = jnp.where(is_ins, (ts <= me) & (ms <= te),
+                            (ts < me) & (ms < te))
+        p_w = jnp.where(strand == 0, ms - ts, te - me)
+        e_w = jnp.where(strand == 0, me - ts, te - ms)
+        wlen = te - ts
+        interior = (p_w >= 3) & (e_w <= wlen - 2)
+        geo = v1[:, None, :] & overlap & real_rows[:, :, None]
+        int_mask = geo & interior
+        edge_mask = geo & ~interior
+        fb = (edge_mask & (wlen < min_fast_edge)).any()
+
+        int_tot, _, _ = batchmod._batch_interior_totals.__wrapped__(
+            reads, rlens, strands, st.tstarts, st.tends,
+            st.win_tpl, st.win_trans, st.wlens,
+            st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
+            st.beta.vals, st.beta.offsets, st.beta.log_scales,
+            st.a_prefix, st.b_suffix, st.baselines,
+            tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
+            mpos_f, mend_f, mtyp, mbase_f, mpos_r, mbase_r,
+            int_mask, st.active)
+
+        # edge mutations are a handful per chunk (window boundaries):
+        # pack them to a fixed slab on device (stable argsort puts
+        # edge-active columns first) so the edge program runs at
+        # EDGE_BUDGET width, not the full chunk; budget overflow bails
+        # to the host loop
+        eb = EDGE_BUDGET
+        e_ok = edge_mask & (wlen >= min_fast_edge)
+        em_any = e_ok.any(axis=1)                       # (Z, chunk)
+        e_over = em_any.sum(axis=1).max() > eb
+        order = jnp.argsort(~em_any, axis=1, stable=True)[:, :eb]
+        packed = jnp.take_along_axis(em_any, order, axis=1)
+        g = lambda a: jnp.take_along_axis(a, order, axis=1)
+        ge_mask = jnp.take_along_axis(
+            e_ok, order[:, None, :].repeat(e_ok.shape[1], 1), axis=2)
+        edge_packed = batchmod._batch_edge_fast_totals.__wrapped__(
+            reads, rlens, strands, st.tstarts, st.tends,
+            st.win_tpl, st.win_trans, st.wlens,
+            st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
+            st.beta.vals, st.beta.offsets, st.beta.log_scales,
+            st.a_prefix, st.b_suffix, st.baselines,
+            tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
+            g(mpos_f), g(mend_f), g(mtyp), g(mbase_f),
+            g(mpos_r), g(mbase_r),
+            ge_mask, st.active)
+        zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
+        edge_tot = jnp.zeros_like(int_tot).at[zidx, order].add(
+            jnp.where(packed, edge_packed, 0.0))
+        return (int_tot + edge_tot, fb | e_over)
+
+    _, (totals, fbs) = lax.scan(one_chunk, None,
+                                (pos_f, end_f, mt, mb, vz))
+    packed_totals = totals.transpose(1, 0, 2).reshape(Z, Mpad)[:, :M]
+    # scatter back to slot-grid layout
+    zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
+    out = jnp.zeros((Z, M)).at[zidx, pack].set(packed_totals)
+    return out, fbs.any()
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge"))
+def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
+                real_rows, skip_mask, *, chunk: int, min_fast_edge: int):
+    """One-dispatch QV sweep: the full slot-grid scores of every non-skip
+    ZMW against its current template, computed on device in a single
+    program (the host-chunked path dispatched C programs with numpy mask
+    building in between -- ~1 s of wall for ~80 ms of device time on the
+    bench workload).  Returns (packed scores (Z, M) f32, fallback): each
+    row's valid-slot scores packed to the front in slot order (stable
+    argsort), which is the host enumeration order, so row z's first
+    arrs[z].size entries line up with enumerate_unique_arrays(tpls[z]).
+    Per-slot values are identical to the chunked path (packing only
+    reorders the chunk axis; no cross-slot arithmetic), and the packed
+    f32 fetch is ~4x smaller than fetching (scores, valid) -- the
+    tunneled link moves ~7 MB/s, so fetch bytes ARE wall time."""
+    start, end, mtype, base, _ = slot_candidates(state.tpl[0],
+                                                 state.tlens[0])
+    valid = jax.vmap(
+        lambda t, L: slot_candidates(t, L)[4]
+    )(state.tpl, state.tlens)
+    valid &= ~skip_mask[:, None]
+    totals, fb = score_slot_grid(
+        state, reads, rlens, strands, table, real_rows,
+        start, end, mtype, base, valid,
+        chunk=chunk, min_fast_edge=min_fast_edge)
+    pack = jnp.argsort(~valid, axis=1, stable=True)
+    packed = jnp.take_along_axis(jnp.where(valid, totals, 0.0), pack, axis=1)
+    return packed.astype(jnp.float32), fb
+
+
 @functools.partial(jax.jit, static_argnames=(
     "width", "use_pallas", "max_iterations", "separation", "neighborhood",
     "chunk", "min_fast_edge"))
@@ -278,122 +509,9 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
                 ll_b, trans_f, tpl_r, trans_r, active)
 
     def score_all(st: RefineLoopState, start, end, mtype, base, valid):
-        """(Z, M) totals over all candidate slots, scanning fixed chunks;
-        also returns the tiny-window fallback flag.
-
-        Candidates are packed per ZMW (stable argsort puts each row's valid
-        slots first) so the live work of sparse rounds -- nearby windows
-        cover a small fraction of the slot grid after round 0 -- compacts
-        into the leading chunk(s) and the all-invalid tail chunks
-        short-circuit.  Scores scatter back to slot-grid layout."""
-        jmax = st.tpl.shape[1]
-        M = jmax * N_SLOTS
-        C = _chunk_count(jmax, chunk)
-        Mpad = C * chunk
-        pad = Mpad - M
-
-        pack = jnp.argsort(~valid, axis=1, stable=True)      # (Z, M)
-        gz = lambda a: jnp.take_along_axis(a, pack, axis=1)
-        gm = lambda a: jnp.take_along_axis(
-            jnp.broadcast_to(a[None, :], (Z, M)), pack, axis=1)
-        p_start, p_end = gm(start), gm(end)
-        p_mtype, p_base = gm(mtype), gm(base)
-        p_valid = gz(valid)
-
-        def padz(a, fill):
-            return jnp.pad(a, [(0, 0), (0, pad)], constant_values=fill)
-
-        cshape = lambda a: a.reshape(Z, C, chunk).transpose(1, 0, 2)
-        pos_f = cshape(padz(p_start, 0))
-        end_f = cshape(padz(p_end, 1))
-        mt = cshape(padz(p_mtype, SUBSTITUTION))
-        mb = cshape(padz(p_base, 0))
-        vz = cshape(padz(p_valid, False))
-
-        tpl32 = st.tpl.astype(jnp.int32)
-        tpl32_r = st.tpl_r.astype(jnp.int32)
-
-        def one_chunk(_, xs):
-            p1, e1, t1, b1, v1 = xs
-            # rounds > 0 restrict candidates to the nearby windows, which
-            # cluster in a few chunks: chunks with no valid candidate
-            # short-circuit (their scores are -inf-masked anyway), cutting
-            # most of the late-round interior compute the host loop avoids
-            # by shrinking its mutation arrays
-            return None, lax.cond(v1.any(),
-                                  lambda: _chunk_compute(p1, e1, t1, b1, v1),
-                                  lambda: (jnp.zeros((Z, chunk)),
-                                           jnp.asarray(False)))
-
-        def _chunk_compute(p1, e1, t1, b1, v1):
-            # p1/e1/t1/b1/v1 are (Z, chunk): per-ZMW packed candidates
-            mpos_f, mend_f, mtyp, mbase_f = p1, e1, t1, b1
-            mpos_r = st.tlens[:, None] - e1
-            mbase_r = jnp.where(b1 < 0, -1, 3 - b1)
-
-            # geometry classification (the host _dispatch_chunk logic)
-            ts = st.tstarts[:, :, None]
-            te = st.tends[:, :, None]
-            strand = strands[:, :, None]
-            ms, me = mpos_f[:, None, :], mend_f[:, None, :]
-            is_ins = (mtyp == INSERTION)[:, None, :]
-            overlap = jnp.where(is_ins, (ts <= me) & (ms <= te),
-                                (ts < me) & (ms < te))
-            p_w = jnp.where(strand == 0, ms - ts, te - me)
-            e_w = jnp.where(strand == 0, me - ts, te - ms)
-            wlen = te - ts
-            interior = (p_w >= 3) & (e_w <= wlen - 2)
-            geo = v1[:, None, :] & overlap & real_rows[:, :, None]
-            int_mask = geo & interior
-            edge_mask = geo & ~interior
-            fb = (edge_mask & (wlen < min_fast_edge)).any()
-
-            int_tot, _, _ = batchmod._batch_interior_totals.__wrapped__(
-                reads, rlens, strands, st.tstarts, st.tends,
-                st.win_tpl, st.win_trans, st.wlens,
-                st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
-                st.beta.vals, st.beta.offsets, st.beta.log_scales,
-                st.a_prefix, st.b_suffix, st.baselines,
-                tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
-                mpos_f, mend_f, mtyp, mbase_f, mpos_r, mbase_r,
-                int_mask, st.active)
-
-            # edge mutations are a handful per chunk (window boundaries):
-            # pack them to a fixed slab on device (stable argsort puts
-            # edge-active columns first) so the edge program runs at
-            # EDGE_BUDGET width, not the full chunk; budget overflow bails
-            # to the host loop
-            eb = EDGE_BUDGET
-            e_ok = edge_mask & (wlen >= min_fast_edge)
-            em_any = e_ok.any(axis=1)                       # (Z, chunk)
-            e_over = em_any.sum(axis=1).max() > eb
-            order = jnp.argsort(~em_any, axis=1, stable=True)[:, :eb]
-            packed = jnp.take_along_axis(em_any, order, axis=1)
-            g = lambda a: jnp.take_along_axis(a, order, axis=1)
-            ge_mask = jnp.take_along_axis(
-                e_ok, order[:, None, :].repeat(e_ok.shape[1], 1), axis=2)
-            edge_packed = batchmod._batch_edge_fast_totals.__wrapped__(
-                reads, rlens, strands, st.tstarts, st.tends,
-                st.win_tpl, st.win_trans, st.wlens,
-                st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
-                st.beta.vals, st.beta.offsets, st.beta.log_scales,
-                st.a_prefix, st.b_suffix, st.baselines,
-                tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
-                g(mpos_f), g(mend_f), g(mtyp), g(mbase_f),
-                g(mpos_r), g(mbase_r),
-                ge_mask, st.active)
-            zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
-            edge_tot = jnp.zeros_like(int_tot).at[zidx, order].add(
-                jnp.where(packed, edge_packed, 0.0))
-            return (int_tot + edge_tot, fb | e_over)
-
-        _, (totals, fbs) = lax.scan(one_chunk, None,
-                                    (pos_f, end_f, mt, mb, vz))
-        packed_totals = totals.transpose(1, 0, 2).reshape(Z, Mpad)[:, :M]
-        # scatter back to slot-grid layout
-        zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
-        out = jnp.zeros((Z, M)).at[zidx, pack].set(packed_totals)
-        return out, fbs.any()
+        return score_slot_grid(st, reads, rlens, strands, table, real_rows,
+                               start, end, mtype, base, valid,
+                               chunk=chunk, min_fast_edge=min_fast_edge)
 
     def body(st: RefineLoopState) -> RefineLoopState:
         jmax = st.tpl.shape[1]

@@ -85,6 +85,38 @@ def test_greedy_matches_best_subset(rng):
         assert got_keys == want_keys, trial
 
 
+def test_greedy_peel_matches_scan(rng):
+    """The data-parallel peeling selection equals the sequential-scan
+    greedy on randomized slot grids, including adversarial cases: equal
+    scores (slot tie-break), domination chains (descending staircases
+    spaced under the separation), and dense favorables."""
+    import jax.numpy as jnp
+
+    for trial in range(24):
+        jmax = int(rng.integers(16, 128))
+        M = jmax * 9
+        start = np.repeat(np.arange(jmax, dtype=np.int32), 9)
+        sep = int(rng.integers(1, 14))
+        kind = trial % 4
+        if kind == 0:
+            scores = rng.normal(0, 3, M)
+        elif kind == 1:  # many exact ties
+            scores = rng.integers(0, 4, M).astype(np.float64)
+        elif kind == 2:  # descending staircase: worst case for peeling
+            scores = np.linspace(10, 0.1, M)
+        else:            # sparse favorables
+            scores = np.where(rng.random(M) < 0.05, rng.normal(3, 1, M),
+                              -1.0)
+        fav = scores > 0
+        a = jnp.asarray(scores, jnp.float32)
+        st = jnp.asarray(start)
+        f = jnp.asarray(fav)
+        got = np.asarray(dr.greedy_well_separated(a, st, f, sep, jmax))
+        want = np.asarray(dr.greedy_well_separated_scan(a, st, f, sep, jmax))
+        np.testing.assert_array_equal(got, want,
+                                      err_msg=f"trial={trial} sep={sep}")
+
+
 def test_splice_matches_apply_mutations(rng):
     import jax.numpy as jnp
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of one full polish and attribute device time
+per HLO op via xprof's hlo_stats converter (no TensorBoard UI needed).
+
+Usage:
+  python tools/trace_polish.py [outdir]          # capture + parse
+  PBCCS_TRACE_PARSE_ONLY=1 python tools/trace_polish.py [outdir]  # parse only
+
+Env: BENCH_ZMWS/BENCH_TPL_LEN/BENCH_PASSES/BENCH_CORRUPTIONS as bench.py.
+Prints a category rollup and the top ops by device self-time, plus one JSON
+summary line (committed to docs/PROFILE_r03.md by hand).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(outdir: str):
+    import numpy as np
+
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import jax
+
+    from bench import build_tasks
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher
+
+    Z = int(os.environ.get("BENCH_ZMWS", 128))
+    L = int(os.environ.get("BENCH_TPL_LEN", 300))
+    P = int(os.environ.get("BENCH_PASSES", 8))
+    NC = int(os.environ.get("BENCH_CORRUPTIONS", 2))
+
+    def run():
+        tasks = build_tasks(np.random.default_rng(20260729), Z, L, P, NC)[0]
+        p = BatchPolisher(tasks)
+        p.refine(RefineOptions(max_iterations=10))
+        p.consensus_qvs()
+
+    run()  # warmup: compile everything
+    with jax.profiler.trace(outdir):
+        run()
+
+
+def parse(outdir: str):
+    from xprof.convert import raw_to_tool_data as r
+
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert paths, f"no xplane.pb under {outdir}"
+    paths = [max(paths, key=os.path.getmtime)]
+    data, _ = r.xspace_to_tool_data(paths, "hlo_stats", {})
+    table = json.loads(data if isinstance(data, str) else data.decode())
+    cols = [c["id"] for c in table["cols"]]
+    idx = {c: i for i, c in enumerate(cols)}
+    rows = []
+    for row in table["rows"]:
+        v = [c.get("v") for c in row["c"]]
+        rows.append({
+            "category": v[idx["category"]],
+            "name": v[idx["hlo_op_name"]],
+            "expr": v[idx["hlo_op_expression"]] or "",
+            "frame_op": v[idx["tf_op_name"]] or "",
+            "occurrences": v[idx["occurrences"]] or 0,
+            "self_us": v[idx["total_self_time"]] or 0.0,
+        })
+    return paths[0], rows
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/pbccs_trace"
+    if not os.environ.get("PBCCS_TRACE_PARSE_ONLY"):
+        capture(outdir)
+    path, rows = parse(outdir)
+    total = sum(r["self_us"] for r in rows)
+    per_cat = collections.defaultdict(float)
+    for r in rows:
+        per_cat[r["category"]] += r["self_us"]
+    print(f"# parsed {path}", file=sys.stderr)
+    print(f"# total device self time: {total / 1e3:.1f} ms", file=sys.stderr)
+    print("\n== category rollup (ms, % of device) ==", file=sys.stderr)
+    rollup = sorted(per_cat.items(), key=lambda kv: -kv[1])
+    for cat, us in rollup:
+        print(f"{cat:28s} {us / 1e3:10.1f}  {100 * us / total:5.1f}%",
+              file=sys.stderr)
+    print("\n== top ops by self time (ms | % | occurrences) ==",
+          file=sys.stderr)
+    ops = sorted(rows, key=lambda r: -r["self_us"])[:40]
+    for r in ops:
+        label = r["frame_op"] or r["name"]
+        print(f"{r['self_us'] / 1e3:9.2f} {100 * r['self_us'] / total:5.1f}% "
+              f"x{r['occurrences']:<6} {r['category']:16s} {label[:90]}",
+              file=sys.stderr)
+    print(json.dumps({
+        "total_device_ms": round(total / 1e3, 1),
+        "categories": {k: round(v / 1e3, 1) for k, v in rollup},
+        "top_ops": [{"name": (r["frame_op"] or r["name"])[:160],
+                     "category": r["category"],
+                     "ms": round(r["self_us"] / 1e3, 2),
+                     "n": r["occurrences"]} for r in ops[:15]],
+    }))
+
+
+if __name__ == "__main__":
+    main()
