@@ -1,0 +1,397 @@
+"""Pallas TPU kernel for dense interior mutation scoring over the slot grid.
+
+The round-3 device profile (docs/PROFILE_r03.md) showed the chunked
+mutation-scoring programs are HBM-bandwidth-bound: every elementwise step of
+the packed (Z, R, chunk, W) pipeline materializes a ~1.6 GB intermediate, so
+one full-grid sweep costs ~440 ms of device time for ~20 GFLOP of useful
+math.  This kernel evaluates the same Extend(2 cols)+Link algebra
+(reference ConsensusCore/src/C++/Arrow/SimpleRecursor.cpp:373-487, :306-357)
+for EVERY slot of the position-major mutation grid (9 slots per template
+position: 4 subs, 4 ins, 1 del -- models/arrow/mutations._SLOT_* order) with
+all intermediates resident in VMEM, writing only the (positions, 9) score
+grid back to HBM.
+
+Why the dense grid maps perfectly onto a kernel: for slot (p, k) every DP
+row the scorer touches -- alpha columns p-2..p+1, beta columns p+1..p+2,
+band offsets, read windows, scale prefixes, virtual-template patches -- sits
+at a STATIC offset from p, so a position-block loads a handful of contiguous
+VMEM slices and the whole 9-slot computation is straight vector math: no
+one-hot row-select matmuls, no candidate packing, no per-mutation gathers.
+
+Scope contract: kernel values are only valid for INTERIOR mutations (window
+position >= 3 and mutation end <= window_len - 2, the same classification
+the batch scorer applies); the interior mask guarantees the simplified
+masks used here (no j==1 start column, no pinned corner, no max_left
+clamps) agree with ops.mutation_score._ext_col.  Non-interior entries
+compute finite garbage that the caller masks out.
+
+Numerics: the in-column first-order recurrence is associated as a
+Hillis-Steele scan (same as ops/fwdbwd_pallas), while the JAX reference path
+uses lax.associative_scan -- values agree to float32 rounding (~1e-5
+relative), not bit-exactly.  Parity: tests/test_dense_score.py fuzzes this
+kernel (interpret mode) against interior_scores_fast and the per-mutation
+extend_link_score oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pbccs_tpu.models.arrow.params import (
+    MISMATCH_PROBABILITY,
+    TRANS_BRANCH,
+    TRANS_DARK,
+    TRANS_MATCH,
+    TRANS_STICK,
+    context_index,
+)
+from pbccs_tpu.ops.fwdbwd import BandedMatrix
+
+_TINY = 1e-30
+_PB = 64          # template positions per kernel step
+_OFF0 = 4         # front padding of every position-indexed input
+_BACKPAD = 12     # back padding (covers p+2 reads at p = Jm-1 plus block pad)
+N_SLOTS = 9
+
+SUB, INS, DEL = 0, 1, 2
+
+
+def dense_score_enabled() -> bool:
+    """Route full-grid interior scoring through this kernel?
+
+    Env override PBCCS_DENSE=1/0; default on for TPU backends, off
+    elsewhere (the packed-chunk JAX path is the CPU reference)."""
+    env = os.environ.get("PBCCS_DENSE")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "off", "no", "")
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# XLA precompute: window-frame patch grids (static shifts, no row selects)
+# --------------------------------------------------------------------------
+
+
+def _shift_pos(x, t: int):
+    """y[j] = x[clip(j + t, 0, n-1)] along axis 0 (static t)."""
+    if t == 0:
+        return x
+    n = x.shape[0]
+    if t > 0:
+        tail = jnp.broadcast_to(x[n - 1:], (t,) + x.shape[1:])
+        return jnp.concatenate([x[t:], tail], axis=0)
+    head = jnp.broadcast_to(x[0:1], (-t,) + x.shape[1:])
+    return jnp.concatenate([head, x[:t]], axis=0)
+
+
+def dense_patch_grids(win_tpl, win_trans, table, wl):
+    """Virtual-mutation patch TRANSITION planes for the full window-frame
+    slot grid.
+
+    win_tpl: (Jm,) int; win_trans: (Jm, 4); table: (8, 4); wl: scalar.
+    Returns trans (Jm, 9, 2, 4) f32 with the same values
+    make_patches_fast produces for (pos=j, mtype, new_base) of each slot
+    -- but via static shifts and a tiny one-hot table lookup only (pos is
+    an arange, so no runtime row selects are needed).  The patch BASES are
+    not materialized: the kernel reads them straight off the window
+    template (bases[0] is always tpl[p-1]; bases[1] is the slot's new
+    base, a constant, or tpl[p+1] for deletions).
+    Slot order: subs A,C,G,T; ins A,C,G,T; del (mutations._SLOT_* tables).
+    """
+    Jm = win_tpl.shape[0]
+    L = jnp.asarray(wl, jnp.int32)
+    pos = jnp.arange(Jm, dtype=jnp.int32)
+    t32 = win_tpl.astype(jnp.int32)
+    prev_b = _shift_pos(t32, -1)
+    next_b = _shift_pos(t32, 1)
+    trans_p1 = _shift_pos(win_trans, 1)
+
+    def T(a, b):
+        idx = jnp.clip(context_index(a, b), 0, 7)
+        oh = (idx[:, None] == jnp.arange(8)).astype(jnp.float32)
+        return jax.lax.dot(oh, table.astype(jnp.float32),
+                           preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST)
+
+    zeros4 = jnp.zeros((Jm, 4), jnp.float32)
+    gate = lambda cond, v: jnp.where(cond[:, None], v, zeros4)
+
+    trans = []
+    for b in range(4):                                       # SUB b
+        nb = jnp.full(Jm, b, jnp.int32)
+        trans.append(jnp.stack([
+            gate(pos > 0, T(prev_b, nb)),
+            gate(pos + 1 < L, T(nb, next_b)),
+        ], 1))
+    for b in range(4):                                       # INS b
+        nb = jnp.full(Jm, b, jnp.int32)
+        trans.append(jnp.stack([
+            gate(pos > 0, T(prev_b, nb)),
+            gate(pos < L, T(nb, t32)),
+        ], 1))
+    trans.append(jnp.stack([                                 # DEL
+        gate((pos > 0) & (pos < L - 1), T(prev_b, next_b)),
+        gate(pos < L - 1, trans_p1),
+    ], 1))
+    return jnp.stack(trans, 1)
+
+
+# --------------------------------------------------------------------------
+# the kernel
+# --------------------------------------------------------------------------
+
+
+def _shift_lanes(x, t: int):
+    """y[..., k] = x[..., k+t] (zeros outside); static t, may be negative."""
+    if t == 0:
+        return x
+    z = jnp.zeros(x.shape[:-1] + (abs(t),), x.dtype)
+    if t > 0:
+        return jnp.concatenate([x[..., t:], z], axis=-1)
+    return jnp.concatenate([z, x[..., :t]], axis=-1)
+
+
+def _select_shift(x, d, lo: int, hi: int):
+    """y[m, k] = x[m, k + clip(d[m], lo, hi)] (zeros outside the band)."""
+    r = jnp.clip(d, lo, hi)
+    out = jnp.zeros_like(x)
+    for t in range(lo, hi + 1):
+        out = jnp.where(r == t, _shift_lanes(x, t), out)
+    return out
+
+
+def _hs_scan(b, c, W: int):
+    """Hillis-Steele solve of v[k] = b[k] + c[k] * v[k-1] along lanes."""
+    d = 1
+    while d < W:
+        f = jnp.full(b.shape[:-1] + (min(d, b.shape[-1]),), 0.0, b.dtype)
+        fc = jnp.ones_like(f)
+        b = b + c * jnp.concatenate([f, b[..., :-d]], axis=-1)
+        c = c * jnp.concatenate([fc, c[..., :-d]], axis=-1)
+        d *= 2
+    return b
+
+
+def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
+                  apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
+                  i_ref, out_ref, *, jm_pad: int, W: int):
+    """Score all 9 slots for _PB template positions per fori step.
+
+    Position-indexed refs are padded so padded[_OFF0 + j] = original[j];
+    every slice below is (_PB, ...) at a static offset from the block
+    start, so the whole step is contiguous VMEM reads + vector math."""
+    hit = 1.0 - MISMATCH_PROBABILITY
+    miss = MISMATCH_PROBABILITY / 3.0
+    I = i_ref[...]  # (1, 1) int32, broadcasts against (PB, W)
+
+    def ext_col(prev, d, o_col, rbase, cur_b, next_b, prev_tr, cur_tr):
+        """One interior ExtendAlpha column over (_PB, W); mirrors
+        ops.mutation_score._ext_col with the interior-only masks."""
+        rows = o_col + lax.broadcasted_iota(jnp.int32, (1, W), 1)
+        in_read = (rows >= 1) & (rows <= I)
+        em = jnp.where(rbase == cur_b, hit, miss)
+        pm1 = _select_shift(prev, d - 1, -1, 7)
+        p0 = _select_shift(prev, d, 0, 7)
+        b = pm1 * em * jnp.where(rows < I, prev_tr[:, TRANS_MATCH:TRANS_MATCH + 1], 0.0)
+        b = b + jnp.where(rows != I,
+                          p0 * prev_tr[:, TRANS_DARK:TRANS_DARK + 1], 0.0)
+        b = jnp.where(in_read, b, 0.0)
+        ins_em = jnp.where(rbase == next_b,
+                           cur_tr[:, TRANS_BRANCH:TRANS_BRANCH + 1],
+                           cur_tr[:, TRANS_STICK:TRANS_STICK + 1] / 3.0)
+        c = jnp.where(in_read & (rows > 1) & (rows < I), ins_em, 0.0)
+        return _hs_scan(b, c, W)
+
+    def link(ext1, o_s1, rn_s1, link_tr, link_b, bcol, d_b, lo: int,
+             apre_s, bsuf_b):
+        rows = o_s1 + lax.broadcasted_iota(jnp.int32, (1, W), 1)
+        em_link = jnp.where(rn_s1 == link_b, hit, miss)
+        beta_ip1 = _select_shift(bcol, d_b + 1, lo + 1, 1)
+        beta_i = _select_shift(bcol, d_b, lo, 0)
+        match = jnp.where(rows < I,
+                          ext1 * link_tr[:, TRANS_MATCH:TRANS_MATCH + 1]
+                          * em_link * beta_ip1, 0.0)
+        dele = ext1 * link_tr[:, TRANS_DARK:TRANS_DARK + 1] * beta_i
+        v = jnp.sum(match + dele, axis=1)
+        return jnp.log(jnp.maximum(v, _TINY)) + apre_s[:, 0] + bsuf_b[:, 0]
+
+    def body(blk, _):
+        base = blk * _PB
+
+        def at(ref, off):
+            return ref[pl.dslice(base + _OFF0 + off, _PB)]
+
+        # shared position-aligned slices
+        a_m1, a_m2 = at(alpha_ref, -1), at(alpha_ref, -2)
+        b_p1, b_p2 = at(beta_ref, 1), at(beta_ref, 2)
+        rb_m1, rb_0, rb_p1 = at(rbase_ref, -1), at(rbase_ref, 0), at(rbase_ref, 1)
+        rn_0, rn_p1 = at(rnext_ref, 0), at(rnext_ref, 1)
+        o_m2, o_m1, o_0 = at(off_ref, -2), at(off_ref, -1), at(off_ref, 0)
+        o_p1, o_p2 = at(off_ref, 1), at(off_ref, 2)
+        ap_m1, ap_0 = at(apre_ref, -1), at(apre_ref, 0)
+        bs_p1, bs_p2 = at(bsuf_ref, 1), at(bsuf_ref, 2)
+        w_m2, w_m1 = at(wtpl_ref, -2), at(wtpl_ref, -1)
+        w_0, w_p1 = at(wtpl_ref, 0), at(wtpl_ref, 1)
+        wt_m3, wt_m2 = at(wtr_ref, -3), at(wtr_ref, -2)
+
+        outs = []
+        # ---- SUB slots (s = p): patch = [prev_b, nb] --------------------
+        for b in range(4):
+            t0 = pt_ref[pl.dslice(base + _OFF0, _PB),
+                        pl.dslice((b * 2 + 0) * 4, 4)]
+            t1 = pt_ref[pl.dslice(base + _OFF0, _PB),
+                        pl.dslice((b * 2 + 1) * 4, 4)]
+            nb = jnp.float32(b)
+            ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
+            ext1 = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1)
+            outs.append(link(ext1, o_p1, rn_p1, t1, w_p1, b_p2,
+                             o_p1 - o_p2, -7, ap_0, bs_p2))
+        # ---- INS slots (s = p): patch = [prev_b, nb] --------------------
+        for b in range(4):
+            sl = 8 + b * 2
+            t0 = pt_ref[pl.dslice(base + _OFF0, _PB), pl.dslice(sl * 4, 4)]
+            t1 = pt_ref[pl.dslice(base + _OFF0, _PB),
+                        pl.dslice((sl + 1) * 4, 4)]
+            nb = jnp.float32(b)
+            ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
+            ext1 = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1)
+            outs.append(link(ext1, o_p1, rn_p1, t1, w_0, b_p1,
+                             jnp.zeros_like(o_p1), -1, ap_0, bs_p1))
+        # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
+        t0 = pt_ref[pl.dslice(base + _OFF0, _PB), pl.dslice(16 * 4, 4)]
+        ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
+                       wt_m3, wt_m2)
+        ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
+        outs.append(link(ext1, o_0, rn_0, t0, w_p1, b_p2,
+                         o_0 - o_p2, -14, ap_m1, bs_p2))
+
+        out_ref[pl.dslice(base, _PB)] = jnp.stack(outs, axis=1)
+        return 0
+
+    lax.fori_loop(0, jm_pad // _PB, body, 0)
+
+
+def _pad_pos(x, jm_pad: int):
+    """Pad a position-indexed per-read array to (R, _OFF0 + jm_pad +
+    _BACKPAD, ...) rows with zeros so row _OFF0 + j = x[:, j]."""
+    n = x.shape[1]
+    total = _OFF0 + jm_pad + _BACKPAD
+    return jnp.pad(x, [(0, 0), (_OFF0, total - _OFF0 - n)]
+                   + [(0, 0)] * (x.ndim - 2))
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
+                                tables, alpha: BandedMatrix,
+                                beta: BandedMatrix, apre, bsuf, width: int):
+    """(R, Jm, 9) window-frame interior scores for a flat read batch.
+
+    reads (R, Imax) int; rlens (R,); win_tpl (R, Jm); win_trans (R, Jm, 4);
+    wlens (R,); tables (R, 8, 4); alpha/beta batched banded fills on the
+    unmutated windows; apre/bsuf (R, nc+1) scale prefixes.  Entry [r, p, k]
+    is the absolute mutated-window log-likelihood of slot (p, k) for read
+    r, valid where the caller's interior classification holds."""
+    from pbccs_tpu.ops.fwdbwd_pallas import window_rows
+
+    R, Imax = reads.shape
+    Jm = win_tpl.shape[1]
+    W = width
+    nc = alpha.vals.shape[1]
+    jm_pad = ((Jm + _PB - 1) // _PB) * _PB
+
+    read_f = jax.vmap(lambda r: r.astype(jnp.float32))(reads)
+    rbase = jax.vmap(lambda rf, o: window_rows(
+        jnp.concatenate([rf[0:1], rf]), o, W))(read_f, alpha.offsets)
+    rnext = jax.vmap(lambda rf, o: window_rows(rf, o, W))(
+        read_f, alpha.offsets)
+
+    ptrans = jax.vmap(dense_patch_grids)(
+        win_tpl.astype(jnp.int32), win_trans, tables, wlens)
+
+    pad = functools.partial(_pad_pos, jm_pad=jm_pad)
+    alpha_p = pad(alpha.vals)
+    beta_p = pad(beta.vals)
+    rbase_p = pad(rbase)
+    rnext_p = pad(rnext)
+    off_p = pad(alpha.offsets[:, :, None].astype(jnp.int32))
+    apre_p = pad(apre[:, :, None].astype(jnp.float32))
+    bsuf_p = pad(bsuf[:, :, None].astype(jnp.float32))
+    wtpl_p = pad(win_tpl[:, :, None].astype(jnp.float32))
+    wtr_p = pad(win_trans)
+    pt_p = pad(ptrans.reshape(R, Jm, 72))
+    i_in = rlens[:, None, None].astype(jnp.int32)
+
+    NP = _OFF0 + jm_pad + _BACKPAD
+    kernel = functools.partial(_dense_kernel, jm_pad=jm_pad, W=W)
+    whole = lambda n: pl.BlockSpec((None, NP, n), lambda r: (r, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(R,),
+        in_specs=[
+            whole(W), whole(W), whole(W), whole(W),      # alpha/beta/rb/rn
+            whole(1), whole(1), whole(1),                # off/apre/bsuf
+            whole(1), whole(4),                          # wtpl/wtrans
+            whole(72),                                   # patch trans
+            pl.BlockSpec((None, 1, 1), lambda r: (r, 0, 0)),  # rlen
+        ],
+        out_specs=pl.BlockSpec((None, jm_pad, N_SLOTS), lambda r: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, jm_pad, N_SLOTS), jnp.float32),
+        interpret=_interpret(),
+    )(
+        alpha_p, beta_p, rbase_p, rnext_p,
+        off_p, apre_p, bsuf_p, wtpl_p, wtr_p, pt_p, i_in,
+    )
+    return out[:, :Jm]
+
+
+# --------------------------------------------------------------------------
+# orientation mapping: window-frame grid -> template-frame slot grid
+# --------------------------------------------------------------------------
+
+# rev-frame slot permutation: sub b <-> sub 3-b, ins b <-> ins 3-b, del
+_REV_PERM = jnp.asarray([3, 2, 1, 0, 7, 6, 5, 4, 8], jnp.int32)
+
+
+def window_grid_to_template(grid, strand, ts, te, Jmax: int):
+    """Map one read's window-frame (Jm, 9) score grid onto the
+    template-frame slot grid (Jmax, 9).
+
+    Forward reads: template position P reads grid[P - ts].  Reverse reads:
+    the window scores live on the reverse-complement template, so slot
+    (P, sub b) reads grid[te-1-P, sub 3-b], (P, ins b) reads
+    grid[te-P, ins 3-b], and (P, del) reads grid[te-1-P, del]
+    (mutations.reverse_complement_arrays frame algebra).  Out-of-window
+    entries return 0 and must be masked by the caller."""
+    Jm = grid.shape[0]
+    z = jnp.zeros((Jmax, grid.shape[1]), grid.dtype)
+    padded = jnp.concatenate([z, grid, z], axis=0)        # [Jmax + w]
+    fwd = lax.dynamic_slice(
+        padded, (Jmax - jnp.clip(ts, 0, Jmax), jnp.int32(0)),
+        (Jmax, N_SLOTS))
+
+    rev_g = padded[::-1][:, _REV_PERM]                    # [-w] frame
+    # reversed[q] = padded[tot-1-q]; want grid[te-1-P] = padded[Jmax+te-1-P]
+    # => q = tot-Jmax-te+P => slice start tot-Jmax-te (+1 for the INS row)
+    tot = padded.shape[0]
+    start = tot - Jmax - jnp.clip(te, 0, Jmax)
+    rev_subdel = lax.dynamic_slice(rev_g, (start, jnp.int32(0)),
+                                   (Jmax, N_SLOTS))
+    rev_ins = lax.dynamic_slice(rev_g, (start - 1, jnp.int32(0)),
+                                (Jmax, N_SLOTS))
+    rev = jnp.concatenate([rev_subdel[:, :4], rev_ins[:, 4:8],
+                           rev_subdel[:, 8:]], axis=1)
+    return jnp.where(strand == 0, fwd, rev)

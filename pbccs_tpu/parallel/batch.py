@@ -43,7 +43,6 @@ from pbccs_tpu.models.arrow.scorer import (
     fill_alpha_beta_batch_zr,
     fills_use_pallas,
     interior_read_scores,
-    mated_mask,
     oriented_window,
     window_moments,
 )
@@ -362,13 +361,68 @@ class BatchPolisher:
         for z in range(self.n_zmws):
             self._real_rows[z, : int(self._n_reads[z])] = True
 
-        self.active = np.zeros((Z, R), bool)
-        self.statuses = np.full((Z, R), -1, np.int32)
-        self.zscores = np.full((Z, R), np.nan)
+        self._stats_host = None  # lazily fetched AddRead statistics
         self._host_tables = np.stack(
             [snr_to_transition_table_host(self._snrs[z]) for z in range(Z)]
         ).astype(np.float32)
         self._setup(first=True)
+
+    # --------------------------------------------------- AddRead statistics
+
+    def _ensure_stats(self) -> None:
+        """Materialize the host-visible AddRead statistics from the device
+        stack in ONE fetch, on first access.  The gate DECISIONS (statuses,
+        active) are fetched verbatim from the device computation so host
+        and device never disagree; z-score VALUES are recomputed in f64
+        for reporting (as before the gates moved on device)."""
+        if self._stats_host is not None:
+            return
+        stats = device_fetch(self._addread_stats_dev, np.float64)
+        ll_a_h, ll_b_h, mu_h, var_h, statuses_f = stats
+        statuses = statuses_f.astype(np.int32)
+        real = self._real_rows
+        mated = real & (statuses != ADD_ALPHABETAMISMATCH)
+        z = (ll_b_h - mu_h) / np.sqrt(np.maximum(var_h, 1e-12))
+        self._stats_host = {
+            "baselines": ll_b_h,
+            "ll_mu": mu_h,
+            "ll_var": var_h,
+            "zscores": np.where(mated, z, np.nan),
+            "statuses": statuses,
+            "active": real & (statuses == ADD_SUCCESS),
+        }
+
+    @property
+    def baselines(self) -> np.ndarray:
+        self._ensure_stats()
+        return self._stats_host["baselines"]
+
+    @property
+    def _ll_mu(self) -> np.ndarray:
+        self._ensure_stats()
+        return self._stats_host["ll_mu"]
+
+    @property
+    def _ll_var(self) -> np.ndarray:
+        self._ensure_stats()
+        return self._stats_host["ll_var"]
+
+    @property
+    def zscores(self) -> np.ndarray:
+        self._ensure_stats()
+        return self._stats_host["zscores"]
+
+    @property
+    def statuses(self) -> np.ndarray:
+        self._ensure_stats()
+        return self._stats_host["statuses"]
+
+    @property
+    def active(self) -> np.ndarray:
+        """AddRead-time active mask (host snapshot; the live refinement
+        mask stays on device as _active_dev)."""
+        self._ensure_stats()
+        return self._stats_host["active"]
 
     # ------------------------------------------------------------------ setup
 
@@ -444,26 +498,28 @@ class BatchPolisher:
 
         self._baselines_dev = ll_b
         if first:
-            # one stacked fetch (device->host transfers cost ~0.1-0.25 s
-            # each over the tunneled link, independent of payload size)
-            stats = device_fetch(jnp.stack([ll_a, ll_b, mu, var]), np.float64)
-            ll_a_h, ll_b_h, mu_h, var_h = stats
-            self.baselines = ll_b_h
-            self._ll_mu = mu_h
-            self._ll_var = var_h
-            mated = mated_mask(ll_a_h, ll_b_h, self._rlens, self._tstarts,
-                               self._tends)
-            real = self._real_rows
-            z = (ll_b_h - self._ll_mu) / np.sqrt(np.maximum(self._ll_var, 1e-12))
-            self.zscores = np.where(real & mated, z, np.nan)
-            ok_z = np.isnan(self.min_zscore) | (
-                np.isfinite(z) & (z >= self.min_zscore))
-            self.active = real & mated & ok_z
-            self.statuses = np.where(
+            # the AddRead gate runs on DEVICE (no fetch: each device->host
+            # round trip costs ~0.1-0.25 s over the tunneled link whatever
+            # the payload); the host-visible statistics (statuses, zscores,
+            # baselines, active) are fetched LAZILY on first access from
+            # the stashed stack -- a bench-style refine+QV run never pays
+            # for them at all
+            z32 = (ll_b - mu) / jnp.sqrt(jnp.maximum(var, 1e-12))
+            if np.isnan(self.min_zscore):
+                ok_z = jnp.ones_like(z32, bool)
+            else:
+                ok_z = jnp.isfinite(z32) & (z32 >= np.float32(self.min_zscore))
+            mated = _mated_mask_dev(ll_a, ll_b, self._rlens_dev,
+                                    self._tstarts_dev, self._tends_dev)
+            real = self._shard(self._real_rows, 1)
+            self._active_dev = real & mated & ok_z
+            statuses = jnp.where(
                 ~real, -1,
-                np.where(~mated, ADD_ALPHABETAMISMATCH,
-                         np.where(~ok_z, ADD_POOR_ZSCORE, ADD_SUCCESS)))
-            self._active_dev = self._shard(self.active, 1)
+                jnp.where(~mated, ADD_ALPHABETAMISMATCH,
+                          jnp.where(~ok_z, ADD_POOR_ZSCORE, ADD_SUCCESS)))
+            self._addread_stats_dev = jnp.stack(
+                [ll_a, ll_b, mu, var, statuses.astype(ll_b.dtype)])
+            self._stats_host = None
         else:
             # refinement-round rebuild: the active-mask update stays on
             # device (no stats fetch); host copies of baselines/active
@@ -863,6 +919,8 @@ class BatchPolisher:
 
         st = self._loop_state(skip, it0=opts.max_iterations - budget)
 
+        from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
+
         out = dr.run_refine_loop(
             st, self._reads_dev, self._rlens_dev, self._strands_dev,
             self._shard(self._host_tables), jnp.asarray(self._real_rows),
@@ -870,24 +928,33 @@ class BatchPolisher:
             max_iterations=opts.max_iterations,
             separation=opts.mutation_separation,
             neighborhood=opts.mutation_neighborhood,
-            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN)
-        # one stacked fetch of the scalar-ish outcome planes
-        summary = device_fetch(jnp.concatenate([
-            out.tlens[None].astype(jnp.int32),
-            out.converged[None].astype(jnp.int32),
-            out.iterations[None], out.n_tested[None], out.n_applied[None],
-            jnp.broadcast_to(out.overflow.astype(jnp.int32), (1, Z)),
-        ]), np.int64)
-        tlens_h, conv_h, iters_h, tested_h, applied_h, overflow_h = summary
+            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
+            dense=dense_score_enabled())
+        # ONE stacked fetch of every outcome plane (each device->host round
+        # trip costs ~0.1-0.25 s over the tunneled link; three sequential
+        # fetches here were ~0.5 s of pure latency per polish)
+        R = self._R
+        packed = jnp.concatenate([
+            jnp.stack([out.tlens.astype(jnp.int32),
+                       out.converged.astype(jnp.int32),
+                       out.iterations, out.n_tested, out.n_applied,
+                       jnp.broadcast_to(out.overflow.astype(jnp.int32),
+                                        (Z,))], axis=1),
+            out.tpl.astype(jnp.int32),
+            out.tstarts.astype(jnp.int32),
+            out.tends.astype(jnp.int32),
+        ], axis=1)
+        h = device_fetch(packed, np.int64)
+        tlens_h, conv_h, iters_h = h[:, 0], h[:, 1], h[:, 2]
+        tested_h, applied_h, overflow_h = h[:, 3], h[:, 4], h[:, 5]
         if overflow_h[0]:
             return None  # host loop re-runs from the polisher's last state
 
-        tpl_h = device_fetch(out.tpl, np.int8)
-        tse = device_fetch(jnp.stack([out.tstarts, out.tends]), np.int64)
+        tpl_h = h[:, 6: 6 + Jmax].astype(np.int8)
         for z in range(self.n_zmws):
             self.tpls[z] = tpl_h[z, : tlens_h[z]].copy()
-        self._tstarts = tse[0].astype(np.int32)
-        self._tends = tse[1].astype(np.int32)
+        self._tstarts = h[:, 6 + Jmax: 6 + Jmax + R].astype(np.int32)
+        self._tends = h[:, 6 + Jmax + R:].astype(np.int32)
         self._tpl_lengths_cache = None
 
         # adopt the loop's final device state so the QV sweep reuses it
@@ -1113,11 +1180,14 @@ class BatchPolisher:
         skip_mask[self.n_zmws:] = True
         for z in skip:
             skip_mask[z] = True
+        from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
+
         packed, fb = dr.run_qv_grid(
             st, self._reads_dev, self._rlens_dev, self._strands_dev,
             self._shard(self._host_tables), jnp.asarray(self._real_rows),
             jnp.asarray(skip_mask),
-            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN)
+            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
+            dense=dense_score_enabled())
         stacked = device_fetch(jnp.concatenate(
             [packed, jnp.broadcast_to(fb.astype(packed.dtype),
                                       (1, packed.shape[1]))], axis=0),

@@ -305,18 +305,118 @@ def _chunk_count(jmax: int, chunk: int) -> int:
     return (jmax * N_SLOTS + chunk - 1) // chunk
 
 
+DENSE_EDGE_BUDGET = 128  # edge slab width for the dense (whole-grid) path
+
+
+def slot_geometry(ts, te, strand, ms, me, is_ins):
+    """Interior-vs-edge classification of mutation slots against read
+    windows (ONE definition, shared by the chunked and dense scoring
+    paths; mirrors the host _dispatch_chunk rules).  All args broadcast;
+    returns (overlap, interior, wlen)."""
+    overlap = jnp.where(is_ins, (ts <= me) & (ms <= te),
+                        (ts < me) & (ms < te))
+    p_w = jnp.where(strand == 0, ms - ts, te - me)
+    e_w = jnp.where(strand == 0, me - ts, te - ms)
+    wlen = te - ts
+    interior = (p_w >= 3) & (e_w <= wlen - 2)
+    return overlap, interior, wlen
+
+
+def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
+                           table, real_rows, start, end, mtype, base,
+                           valid, *, min_fast_edge: int):
+    """Dense-path (Z, M) slot-grid totals: interior scores come from the
+    Pallas dense kernel (ops/dense_score_pallas) -- one whole-grid pass
+    with VMEM-resident intermediates instead of the chunk scan whose
+    materialized (Z, R, chunk, W) intermediates made the packed path
+    HBM-bound (docs/PROFILE_r03.md) -- and edge mutations pack into one
+    DENSE_EDGE_BUDGET slab across the full grid."""
+    from pbccs_tpu.ops.dense_score_pallas import (
+        dense_interior_scores_batch, window_grid_to_template)
+    from pbccs_tpu.parallel import batch as batchmod
+
+    Z, R = reads.shape[:2]
+    jmax = st.tpl.shape[1]
+    M = jmax * N_SLOTS
+
+    # geometry classification over the full grid
+    overlap, interior, wlen = slot_geometry(
+        st.tstarts[:, :, None], st.tends[:, :, None], strands[:, :, None],
+        start[None, None, :], end[None, None, :],
+        (mtype == INSERTION)[None, None, :])
+    geo = valid[:, None, :] & overlap & real_rows[:, :, None]
+    int_mask = geo & interior & st.active[:, :, None]
+    edge_mask = geo & ~interior
+    fb = (edge_mask & (wlen < min_fast_edge)).any()
+
+    # interior: dense kernel in window frame, then per-read orientation map
+    flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
+    tables = flat(jnp.broadcast_to(table[:, None], (Z, R) + table.shape[1:]))
+    W = st.alpha.vals.shape[-1]
+    grid_w = dense_interior_scores_batch(
+        flat(reads), flat(rlens), flat(st.win_tpl), flat(st.win_trans),
+        flat(st.wlens), tables,
+        BandedMatrix(flat(st.alpha.vals), flat(st.alpha.offsets),
+                     flat(st.alpha.log_scales)),
+        BandedMatrix(flat(st.beta.vals), flat(st.beta.offsets),
+                     flat(st.beta.log_scales)),
+        flat(st.a_prefix), flat(st.b_suffix), W)
+    mapped = jax.vmap(
+        lambda g, s, a, b: window_grid_to_template(g, s, a, b, jmax)
+    )(grid_w, flat(strands), flat(st.tstarts), flat(st.tends))
+    mapped = mapped.reshape(Z, R, M)
+    int_tot = jnp.sum(
+        jnp.where(int_mask, mapped - st.baselines[:, :, None], 0.0), axis=1)
+
+    # edge: one packed slab across the full grid
+    eb = DENSE_EDGE_BUDGET
+    e_ok = edge_mask & (wlen >= min_fast_edge) & st.active[:, :, None]
+    em_any = e_ok.any(axis=1)                                # (Z, M)
+    e_over = em_any.sum(axis=1).max() > eb
+    order = jnp.argsort(~em_any, axis=1, stable=True)[:, :eb]
+    packed = jnp.take_along_axis(em_any, order, axis=1)
+    gm = lambda a: jnp.take_along_axis(
+        jnp.broadcast_to(a[None, :], (Z, M)), order, axis=1)
+    ge_mask = jnp.take_along_axis(
+        e_ok, order[:, None, :].repeat(R, 1), axis=2)
+    g_end = gm(end)
+    g_base = gm(base)
+    tpl32 = st.tpl.astype(jnp.int32)
+    tpl32_r = st.tpl_r.astype(jnp.int32)
+    edge_packed = batchmod._batch_edge_fast_totals.__wrapped__(
+        reads, rlens, strands, st.tstarts, st.tends,
+        st.win_tpl, st.win_trans, st.wlens,
+        st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
+        st.beta.vals, st.beta.offsets, st.beta.log_scales,
+        st.a_prefix, st.b_suffix, st.baselines,
+        tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
+        gm(start), g_end, gm(mtype), g_base,
+        st.tlens[:, None] - g_end,
+        jnp.where(g_base < 0, -1, 3 - g_base),
+        ge_mask, st.active)
+    zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
+    out = int_tot.at[zidx, order].add(jnp.where(packed, edge_packed, 0.0))
+    return out, fb | e_over
+
+
 def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
                     real_rows, start, end, mtype, base, valid, *,
-                    chunk: int, min_fast_edge: int):
-    """(Z, M) totals over all candidate slots, scanning fixed chunks;
-    also returns the tiny-window fallback flag.  Shared by the refinement
-    loop's per-round scoring and the one-dispatch QV sweep (run_qv_grid).
+                    chunk: int, min_fast_edge: int, dense: bool = False):
+    """(Z, M) totals over all candidate slots; also returns the
+    tiny-window fallback flag.  Shared by the refinement loop's per-round
+    scoring and the one-dispatch QV sweep (run_qv_grid).
 
-    Candidates are packed per ZMW (stable argsort puts each row's valid
-    slots first) so the live work of sparse rounds -- nearby windows
-    cover a small fraction of the slot grid after round 0 -- compacts
-    into the leading chunk(s) and the all-invalid tail chunks
+    With dense=True the interior scores come from the Pallas dense-grid
+    kernel (_score_slot_grid_dense, the TPU path).  Otherwise candidates
+    are packed per ZMW (stable argsort puts each row's valid slots first)
+    and scored in fixed chunks: the live work of sparse rounds -- nearby
+    windows cover a small fraction of the slot grid after round 0 --
+    compacts into the leading chunk(s) and the all-invalid tail chunks
     short-circuit.  Scores scatter back to slot-grid layout."""
+    if dense:
+        return _score_slot_grid_dense(st, reads, rlens, strands, table,
+                                      real_rows, start, end, mtype, base,
+                                      valid, min_fast_edge=min_fast_edge)
     from pbccs_tpu.parallel import batch as batchmod
 
     Z = reads.shape[0]
@@ -366,17 +466,10 @@ def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
         mbase_r = jnp.where(b1 < 0, -1, 3 - b1)
 
         # geometry classification (the host _dispatch_chunk logic)
-        ts = st.tstarts[:, :, None]
-        te = st.tends[:, :, None]
-        strand = strands[:, :, None]
-        ms, me = mpos_f[:, None, :], mend_f[:, None, :]
-        is_ins = (mtyp == INSERTION)[:, None, :]
-        overlap = jnp.where(is_ins, (ts <= me) & (ms <= te),
-                            (ts < me) & (ms < te))
-        p_w = jnp.where(strand == 0, ms - ts, te - me)
-        e_w = jnp.where(strand == 0, me - ts, te - ms)
-        wlen = te - ts
-        interior = (p_w >= 3) & (e_w <= wlen - 2)
+        overlap, interior, wlen = slot_geometry(
+            st.tstarts[:, :, None], st.tends[:, :, None],
+            strands[:, :, None], mpos_f[:, None, :], mend_f[:, None, :],
+            (mtyp == INSERTION)[:, None, :])
         geo = v1[:, None, :] & overlap & real_rows[:, :, None]
         int_mask = geo & interior
         edge_mask = geo & ~interior
@@ -430,9 +523,11 @@ def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
     return out, fbs.any()
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge"))
+@functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge",
+                                             "dense"))
 def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
-                real_rows, skip_mask, *, chunk: int, min_fast_edge: int):
+                real_rows, skip_mask, *, chunk: int, min_fast_edge: int,
+                dense: bool = False):
     """One-dispatch QV sweep: the full slot-grid scores of every non-skip
     ZMW against its current template, computed on device in a single
     program (the host-chunked path dispatched C programs with numpy mask
@@ -454,7 +549,7 @@ def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
     totals, fb = score_slot_grid(
         state, reads, rlens, strands, table, real_rows,
         start, end, mtype, base, valid,
-        chunk=chunk, min_fast_edge=min_fast_edge)
+        chunk=chunk, min_fast_edge=min_fast_edge, dense=dense)
     pack = jnp.argsort(~valid, axis=1, stable=True)
     packed = jnp.take_along_axis(jnp.where(valid, totals, 0.0), pack, axis=1)
     return packed.astype(jnp.float32), fb
@@ -462,11 +557,12 @@ def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
 
 @functools.partial(jax.jit, static_argnames=(
     "width", "use_pallas", "max_iterations", "separation", "neighborhood",
-    "chunk", "min_fast_edge"))
+    "chunk", "min_fast_edge", "dense"))
 def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
                     real_rows, *, width: int, use_pallas: bool,
                     max_iterations: int, separation: int,
-                    neighborhood: int, chunk: int, min_fast_edge: int):
+                    neighborhood: int, chunk: int, min_fast_edge: int,
+                    dense: bool = False):
     """The jitted device refinement loop: up to max_iterations rounds of
     enumerate -> score -> select -> splice -> rebuild entirely on device
     (lax.while_loop with early exit), so the host fetches once.  A
@@ -511,7 +607,8 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
     def score_all(st: RefineLoopState, start, end, mtype, base, valid):
         return score_slot_grid(st, reads, rlens, strands, table, real_rows,
                                start, end, mtype, base, valid,
-                               chunk=chunk, min_fast_edge=min_fast_edge)
+                               chunk=chunk, min_fast_edge=min_fast_edge,
+                               dense=dense)
 
     def body(st: RefineLoopState) -> RefineLoopState:
         jmax = st.tpl.shape[1]

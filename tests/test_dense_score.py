@@ -1,0 +1,198 @@
+"""Parity of the dense slot-grid Pallas scorer (ops/dense_score_pallas,
+interpret mode on CPU) with the packed interior scorer it replaces on TPU.
+
+The dense kernel computes every (position, slot) interior score with
+VMEM-resident intermediates; values must match interior_read_scores_fast
+(which is itself parity-tested against the per-mutation extend_link_score
+oracle in test_mutation_fast.py) to float32 rounding on every
+interior-classified slot, for both strands and clipped read windows."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pbccs_tpu.models.arrow.params import (  # noqa: E402
+    revcomp_padded,
+    snr_to_transition_table_host,
+    template_transition_params,
+)
+from pbccs_tpu.models.arrow.scorer import (  # noqa: E402
+    fill_alpha_beta_batch,
+    oriented_window,
+)
+from pbccs_tpu.ops import dense_score_pallas as dsp  # noqa: E402
+from pbccs_tpu.ops.fwdbwd import BandedMatrix  # noqa: E402
+from pbccs_tpu.ops.mutation_score import (  # noqa: E402
+    interior_read_scores_fast,
+    make_patches_fast,
+)
+from pbccs_tpu.parallel import device_refine as dr  # noqa: E402
+from pbccs_tpu.simulate import simulate_zmw  # noqa: E402
+
+W = 16
+
+
+def _setup_case(rng, L, n_reads, windows):
+    """Build oriented windows + fills for one ZMW with given read windows
+    [(strand, ts, te)] and return everything both scorers need."""
+    tpl, reads, strands, snr = simulate_zmw(rng, L, n_reads)
+    Jmax = ((L + 63) // 64) * 64
+    Imax = Jmax + 32
+    table = jnp.asarray(snr_to_transition_table_host(np.asarray(snr)))
+    tpl_p = jnp.asarray(np.pad(tpl, (0, Jmax - L), constant_values=4))
+    tlen = jnp.int32(L)
+    trans_f = template_transition_params(tpl_p, table, tlen)
+    tpl_r = revcomp_padded(tpl_p, tlen)
+    trans_r = template_transition_params(tpl_r, table, tlen)
+
+    R = len(windows)
+    reads_p = np.full((R, Imax), 4, np.int8)
+    rlens = np.zeros(R, np.int32)
+    st = np.zeros(R, np.int32)
+    ts_a = np.zeros(R, np.int32)
+    te_a = np.zeros(R, np.int32)
+    for i, (strand, ts, te) in enumerate(windows):
+        r = np.asarray(reads[i % n_reads])
+        # clip the read roughly to the window span so fills stay sane
+        r = r[: max(te - ts + 8, 16)]
+        reads_p[i, : len(r)] = r
+        rlens[i] = len(r)
+        st[i], ts_a[i], te_a[i] = strand, ts, te
+
+    win = jax.vmap(
+        lambda s, a, b: oriented_window(s, a, b, tpl_p, trans_f,
+                                        tpl_r, trans_r, tlen)
+    )(jnp.asarray(st), jnp.asarray(ts_a), jnp.asarray(te_a))
+    win_tpl, win_trans, wlens = win
+    alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
+        jnp.asarray(reads_p), jnp.asarray(rlens), win_tpl, win_trans,
+        wlens, W, use_pallas=False)
+    return dict(tpl=tpl, tpl_p=tpl_p, tlen=tlen, table=table,
+                trans_f=trans_f, tpl_r=tpl_r, trans_r=trans_r,
+                reads=jnp.asarray(reads_p), rlens=jnp.asarray(rlens),
+                strands=jnp.asarray(st), ts=jnp.asarray(ts_a),
+                te=jnp.asarray(te_a), win_tpl=win_tpl,
+                win_trans=win_trans, wlens=wlens, alpha=alpha, beta=beta,
+                apre=apre, bsuf=bsuf, Jmax=Jmax)
+
+
+def _expected_grid(case, r):
+    """Template-frame (Jmax*9,) interior scores via the packed scorer."""
+    Jmax = case["Jmax"]
+    start, end, mtype, base, valid = dr.slot_candidates(
+        case["tpl_p"].astype(jnp.int8), case["tlen"])
+    mpos_r = case["tlen"] - end
+    mbase_r = jnp.where(base < 0, -1, 3 - base)
+    pf = make_patches_fast(case["tpl_p"].astype(jnp.int32), case["trans_f"],
+                           case["table"], case["tlen"], start, mtype, base)
+    pr = make_patches_fast(case["tpl_r"].astype(jnp.int32), case["trans_r"],
+                           case["table"], case["tlen"], mpos_r, mtype,
+                           mbase_r)
+    lls = interior_read_scores_fast(
+        case["reads"][r], case["rlens"][r], case["strands"][r],
+        case["ts"][r], case["te"][r], case["win_tpl"][r],
+        case["win_trans"][r], case["wlens"][r],
+        BandedMatrix(case["alpha"].vals[r], case["alpha"].offsets[r],
+                     case["alpha"].log_scales[r]),
+        BandedMatrix(case["beta"].vals[r], case["beta"].offsets[r],
+                     case["beta"].log_scales[r]),
+        case["apre"][r], case["bsuf"][r], start, end, mtype, pf, pr)
+    return np.asarray(lls), (start, end, mtype, base, valid)
+
+
+def _interior_mask(case, r, start, end, mtype, valid):
+    """The batch scorer's interior classification for one read."""
+    ts, te = int(case["ts"][r]), int(case["te"][r])
+    strand = int(case["strands"][r])
+    s, e = np.asarray(start), np.asarray(end)
+    is_ins = np.asarray(mtype) == dr.INSERTION
+    overlap = np.where(is_ins, (ts <= e) & (s <= te), (ts < e) & (s < te))
+    p_w = (s - ts) if strand == 0 else (te - e)
+    e_w = (e - ts) if strand == 0 else (te - s)
+    wlen = te - ts
+    interior = (p_w >= 3) & (e_w <= wlen - 2)
+    return np.asarray(valid) & overlap & interior
+
+
+def _dense_grid(case, r):
+    """Template-frame (Jmax, 9) scores via the dense kernel + mapping."""
+    tables = jnp.broadcast_to(case["table"][None], (case["reads"].shape[0], 8, 4))
+    grid_w = dsp.dense_interior_scores_batch(
+        case["reads"], case["rlens"], case["win_tpl"], case["win_trans"],
+        case["wlens"], tables, case["alpha"], case["beta"],
+        case["apre"], case["bsuf"], W)
+    mapped = dsp.window_grid_to_template(
+        grid_w[r], case["strands"][r], case["ts"][r], case["te"][r],
+        case["Jmax"])
+    return np.asarray(mapped)
+
+
+@pytest.mark.parametrize("windows", [
+    [(0, 0, 60), (0, 0, 60)],              # forward, full window
+    [(1, 0, 60), (1, 0, 60)],              # reverse, full window
+    [(0, 5, 56), (1, 3, 58)],              # clipped windows, both strands
+])
+def test_dense_matches_packed_interior(rng, windows):
+    case = _setup_case(rng, 60, 2, windows)
+    for r in range(len(windows)):
+        want, (start, end, mtype, base, valid) = _expected_grid(case, r)
+        got = _dense_grid(case, r).reshape(-1)
+        mask = _interior_mask(case, r, start, end, mtype, valid)
+        assert mask.sum() > 100, "test case exercises too few slots"
+        np.testing.assert_allclose(got[mask], want[mask],
+                                   rtol=2e-5, atol=2e-3,
+                                   err_msg=f"read {r} windows={windows}")
+
+
+def test_qv_grid_dense_matches_chunked(rng):
+    """End-to-end: run_qv_grid with dense=True (kernel in interpret mode)
+    produces the same packed slot scores as the chunked path on a real
+    polisher state, to float32 rounding."""
+    from pbccs_tpu.parallel.batch import (MIN_FAST_EDGE_WLEN, MUT_CHUNK,
+                                          BatchPolisher, ZmwTask)
+    from pbccs_tpu.parallel.batch import device_fetch  # noqa: F401
+
+    tasks = []
+    for z in range(2):
+        tpl, reads, strands, snr = simulate_zmw(rng, 60, 4)
+        draft = tpl.copy()
+        draft[30] = (draft[30] + 1) % 4
+        tasks.append(ZmwTask(f"q/{z}", draft, snr, reads, strands,
+                             [0] * 4, [len(draft)] * 4))
+    p = BatchPolisher(tasks)
+    st = p._loop_state(set())
+    skip_mask = np.zeros(p._Z, bool)
+    skip_mask[p.n_zmws:] = True
+    args = (st, p._reads_dev, p._rlens_dev, p._strands_dev,
+            p._shard(p._host_tables), jnp.asarray(p._real_rows),
+            jnp.asarray(skip_mask))
+    kw = dict(chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN)
+    chunked, fb_c = dr.run_qv_grid(*args, **kw, dense=False)
+    dense, fb_d = dr.run_qv_grid(*args, **kw, dense=True)
+    assert bool(fb_c) == bool(fb_d)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(chunked),
+                               rtol=2e-5, atol=2e-3)
+
+
+def test_dense_patch_grids_match_make_patches(rng):
+    """Window-frame patch planes equal make_patches_fast on the grid."""
+    tpl, _, _, snr = simulate_zmw(rng, 50, 3)
+    L = len(tpl)
+    table = jnp.asarray(snr_to_transition_table_host(np.asarray(snr)))
+    tpl_j = jnp.asarray(tpl.astype(np.int32))
+    trans = template_transition_params(tpl_j, table, jnp.int32(L))
+
+    ptrans = dsp.dense_patch_grids(tpl_j, trans, table, L)
+
+    from pbccs_tpu.models.arrow.mutations import (_SLOT_BASES, _SLOT_TYPES)
+    pos = np.repeat(np.arange(L, dtype=np.int32), 9)
+    mtype = np.tile(np.asarray(_SLOT_TYPES), L)
+    nbase = np.tile(np.asarray(_SLOT_BASES), L)
+    ref = make_patches_fast(tpl_j, trans, table, jnp.int32(L),
+                            jnp.asarray(pos), jnp.asarray(mtype),
+                            jnp.asarray(np.where(nbase < 0, 0, nbase)))
+    got_t = np.asarray(ptrans).reshape(L * 9, 2, 4)
+    want_t = np.asarray(ref.trans)
+    np.testing.assert_allclose(got_t, want_t, rtol=1e-6, atol=1e-7)
