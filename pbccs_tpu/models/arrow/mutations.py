@@ -323,3 +323,34 @@ def oriented_mutation(m: Mutation, strand: int, tstart: int, tend: int) -> Mutat
         return Mutation(cm.start - tstart, cm.end - tstart, cm.mtype, cm.new_base, cm.score)
     comp = {-1: -1, 0: 3, 1: 2, 2: 1, 3: 0}
     return Mutation(tend - cm.end, tend - cm.start, cm.mtype, comp[cm.new_base], cm.score)
+
+
+# ---------------------------------------------------------------------- QVs
+
+_LN10 = float(np.log(10.0))
+# Value the direct f64 aggregation yields when no negative-scoring mutation
+# exists at a position (prob clamps to float64 tiny):
+# round(-10*log10(2.225e-308)) == 3077.  Kept as the saturation value so the
+# stable form below is output-compatible with the legacy evaluation.
+QV_SATURATED = int(np.round(-10.0 * np.log10(np.finfo(np.float64).tiny)))
+
+
+def qvs_from_neg_sums(ssum: np.ndarray) -> np.ndarray:
+    """Per-position consensus QVs from the summed exp(score) of
+    negative-scoring single-base mutations (reference ConsensusQVs,
+    Consensus-inl.hpp:277-297).
+
+    Stable log-space form: QV = -10*log10(ssum / (1 + ssum)), evaluated as
+    -10*(log ssum - softplus(log ssum))/ln 10.  Algebraically identical to
+    the reference's -10*log10(1 - 1/(1 + ssum)) but free of that form's
+    catastrophic cancellation, which pins every position with
+    ssum < ~1e-16 (all mutation scores below ~-37 nats, routine at high
+    pass counts) to the tiny-clamp value.  Positions with NO negative
+    mutation keep the legacy clamp value QV_SATURATED; downstream
+    consumers clamp to [0, 93] (pipeline QVsToASCII, reference
+    Consensus.h:328-339), where both forms agree everywhere."""
+    ssum = np.asarray(ssum, np.float64)
+    with np.errstate(divide="ignore"):
+        t = np.log(ssum)
+    qv = -10.0 * (t - np.logaddexp(0.0, t)) / _LN10
+    return np.where(ssum > 0.0, np.round(qv), QV_SATURATED).astype(np.int32)

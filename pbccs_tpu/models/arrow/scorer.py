@@ -594,7 +594,8 @@ class ArrowMultiReadScorer:
 
         Parity: ConsensusQVs (reference Consensus-inl.hpp:277-297): only
         negative-scoring mutations contribute exp(score); QV =
-        -10*log10(1 - 1/(1 + sum))."""
+        -10*log10(ssum/(1+ssum)) via the shared stable aggregation
+        (mutations.qvs_from_neg_sums)."""
         tpl = self.tpl
         muts = mutlib.enumerate_unique(tpl)
         scores = self.score_mutations(muts)
@@ -602,7 +603,4 @@ class ArrowMultiReadScorer:
         for m, s in zip(muts, scores):
             if s < 0.0:
                 score_sum[m.start] += np.exp(s)
-        prob = 1.0 - 1.0 / (1.0 + score_sum)
-        prob = np.maximum(prob, np.finfo(float).tiny)
-        qv = np.round(-10.0 * np.log10(prob)).astype(np.int32)
-        return qv
+        return mutlib.qvs_from_neg_sums(score_sum)

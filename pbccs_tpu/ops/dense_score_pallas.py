@@ -248,36 +248,34 @@ def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         w_0, w_p1 = at(wtpl_ref, 0), at(wtpl_ref, 1)
         wt_m3, wt_m2 = at(wtr_ref, -3), at(wtr_ref, -2)
 
-        outs = []
-        # ---- SUB slots (s = p): patch = [prev_b, nb] --------------------
+        outs = [None] * N_SLOTS
+        # ---- SUB + INS slots (s = p): patch = [prev_b, nb] --------------
+        # SUB b and INS b have the IDENTICAL first extend column (same
+        # patched transitions T(prev_b, nb) and same alpha seed); compute
+        # ext0 once per base and branch only on the second column, saving
+        # 4 of the 18 ext_col evaluations per position block.
         for b in range(4):
             t0 = pt_ref[pl.dslice(base + _OFF0, _PB),
                         pl.dslice((b * 2 + 0) * 4, 4)]
-            t1 = pt_ref[pl.dslice(base + _OFF0, _PB),
-                        pl.dslice((b * 2 + 1) * 4, 4)]
+            t1s = pt_ref[pl.dslice(base + _OFF0, _PB),
+                         pl.dslice((b * 2 + 1) * 4, 4)]
+            t1i = pt_ref[pl.dslice(base + _OFF0, _PB),
+                         pl.dslice((8 + b * 2 + 1) * 4, 4)]
             nb = jnp.float32(b)
             ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
-            ext1 = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1)
-            outs.append(link(ext1, o_p1, rn_p1, t1, w_p1, b_p2,
-                             o_p1 - o_p2, -7, ap_0, bs_p2))
-        # ---- INS slots (s = p): patch = [prev_b, nb] --------------------
-        for b in range(4):
-            sl = 8 + b * 2
-            t0 = pt_ref[pl.dslice(base + _OFF0, _PB), pl.dslice(sl * 4, 4)]
-            t1 = pt_ref[pl.dslice(base + _OFF0, _PB),
-                        pl.dslice((sl + 1) * 4, 4)]
-            nb = jnp.float32(b)
-            ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
-            ext1 = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1)
-            outs.append(link(ext1, o_p1, rn_p1, t1, w_0, b_p1,
-                             jnp.zeros_like(o_p1), -1, ap_0, bs_p1))
+            ext1s = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1s)
+            outs[b] = link(ext1s, o_p1, rn_p1, t1s, w_p1, b_p2,
+                           o_p1 - o_p2, -7, ap_0, bs_p2)
+            ext1i = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1i)
+            outs[4 + b] = link(ext1i, o_p1, rn_p1, t1i, w_0, b_p1,
+                               jnp.zeros_like(o_p1), -1, ap_0, bs_p1)
         # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
         t0 = pt_ref[pl.dslice(base + _OFF0, _PB), pl.dslice(16 * 4, 4)]
         ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
                        wt_m3, wt_m2)
         ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
-        outs.append(link(ext1, o_0, rn_0, t0, w_p1, b_p2,
-                         o_0 - o_p2, -14, ap_m1, bs_p2))
+        outs[8] = link(ext1, o_0, rn_0, t0, w_p1, b_p2,
+                       o_0 - o_p2, -14, ap_m1, bs_p2)
 
         out_ref[pl.dslice(base, _PB)] = jnp.stack(outs, axis=1)
         return 0
@@ -375,23 +373,28 @@ def window_grid_to_template(grid, strand, ts, te, Jmax: int):
     (P, sub b) reads grid[te-1-P, sub 3-b], (P, ins b) reads
     grid[te-P, ins 3-b], and (P, del) reads grid[te-1-P, del]
     (mutations.reverse_complement_arrays frame algebra).  Out-of-window
-    entries return 0 and must be masked by the caller."""
-    Jm = grid.shape[0]
-    z = jnp.zeros((Jmax, grid.shape[1]), grid.dtype)
-    padded = jnp.concatenate([z, grid, z], axis=0)        # [Jmax + w]
-    fwd = lax.dynamic_slice(
-        padded, (Jmax - jnp.clip(ts, 0, Jmax), jnp.int32(0)),
-        (Jmax, N_SLOTS))
+    entries return 0 and must be masked by the caller.
 
-    rev_g = padded[::-1][:, _REV_PERM]                    # [-w] frame
-    # reversed[q] = padded[tot-1-q]; want grid[te-1-P] = padded[Jmax+te-1-P]
-    # => q = tot-Jmax-te+P => slice start tot-Jmax-te (+1 for the INS row)
-    tot = padded.shape[0]
-    start = tot - Jmax - jnp.clip(te, 0, Jmax)
-    rev_subdel = lax.dynamic_slice(rev_g, (start, jnp.int32(0)),
-                                   (Jmax, N_SLOTS))
-    rev_ins = lax.dynamic_slice(rev_g, (start - 1, jnp.int32(0)),
-                                (Jmax, N_SLOTS))
+    Index-shift gather formulation: under the caller's vmap this is ONE
+    batched gather per frame instead of a dynamic_slice per read -- the
+    per-read dynamic slices lowered to ~16% of all device time
+    (dynamic-update-slice x3072) on the round-3 bench trace."""
+    Jm = grid.shape[0]
+    gpad = jnp.concatenate(
+        [grid, jnp.zeros((1, grid.shape[1]), grid.dtype)], axis=0)
+    sentinel = Jm                                          # zero row
+
+    def pick(idx):
+        safe = jnp.where((idx >= 0) & (idx < Jm), idx, sentinel)
+        return jnp.take(gpad, safe, axis=0)
+
+    P = jnp.arange(Jmax, dtype=jnp.int32)
+    fwd = pick(P - ts)
+    rev_g = gpad[:, _REV_PERM]
+    pick_r = lambda idx: jnp.take(
+        rev_g, jnp.where((idx >= 0) & (idx < Jm), idx, sentinel), axis=0)
+    rev_subdel = pick_r(te - 1 - P)
+    rev_ins = pick_r(te - P)
     rev = jnp.concatenate([rev_subdel[:, :4], rev_ins[:, 4:8],
                            rev_subdel[:, 8:]], axis=1)
     return jnp.where(strand == 0, fwd, rev)

@@ -821,6 +821,7 @@ class BatchPolisher:
         """Splice per-ZMW mutations, remap read windows, rebuild fills."""
         changed: list[int] = []
         self._tpl_lengths_cache = None
+        self._qv_cache = None
         for z, best in enumerate(best_per_zmw):
             if not best:
                 continue
@@ -921,6 +922,7 @@ class BatchPolisher:
 
         from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
 
+        self._qv_cache = None
         out = dr.run_refine_loop(
             st, self._reads_dev, self._rlens_dev, self._strands_dev,
             self._shard(self._host_tables), jnp.asarray(self._real_rows),
@@ -928,6 +930,21 @@ class BatchPolisher:
             max_iterations=opts.max_iterations,
             separation=opts.mutation_separation,
             neighborhood=opts.mutation_neighborhood,
+            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
+            dense=dense_score_enabled())
+        # Eager QV sweep on the loop's final state, dispatched back-to-back
+        # with the loop program (no host sync between them): consensus_qvs
+        # serves from the cached integers, so a refine+QV polish pays ONE
+        # device->host fetch total instead of a separate ~1.5 MB score
+        # fetch + round trip over the tunneled link.
+        qv_skip = np.zeros(Z, bool)
+        qv_skip[self.n_zmws:] = True
+        for z in (skip or ()):
+            qv_skip[z] = True
+        qv_i, qv_fb = dr.run_qv_ints(
+            out, self._reads_dev, self._rlens_dev, self._strands_dev,
+            self._shard(self._host_tables), jnp.asarray(self._real_rows),
+            jnp.asarray(qv_skip),
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
             dense=dense_score_enabled())
         # ONE stacked fetch of every outcome plane (each device->host round
@@ -939,22 +956,28 @@ class BatchPolisher:
                        out.converged.astype(jnp.int32),
                        out.iterations, out.n_tested, out.n_applied,
                        jnp.broadcast_to(out.overflow.astype(jnp.int32),
-                                        (Z,))], axis=1),
+                                        (Z,)),
+                       jnp.broadcast_to(qv_fb.astype(jnp.int32), (Z,))],
+                      axis=1),
             out.tpl.astype(jnp.int32),
             out.tstarts.astype(jnp.int32),
             out.tends.astype(jnp.int32),
+            qv_i,
         ], axis=1)
         h = device_fetch(packed, np.int64)
         tlens_h, conv_h, iters_h = h[:, 0], h[:, 1], h[:, 2]
         tested_h, applied_h, overflow_h = h[:, 3], h[:, 4], h[:, 5]
         if overflow_h[0]:
             return None  # host loop re-runs from the polisher's last state
+        if not h[0, 6]:  # no tiny-window fallback in the QV sweep
+            self._qv_cache = (frozenset(skip or ()),
+                              h[:, 7 + Jmax + 2 * R:].astype(np.int32))
 
-        tpl_h = h[:, 6: 6 + Jmax].astype(np.int8)
+        tpl_h = h[:, 7: 7 + Jmax].astype(np.int8)
         for z in range(self.n_zmws):
             self.tpls[z] = tpl_h[z, : tlens_h[z]].copy()
-        self._tstarts = h[:, 6 + Jmax: 6 + Jmax + R].astype(np.int32)
-        self._tends = h[:, 6 + Jmax + R:].astype(np.int32)
+        self._tstarts = h[:, 7 + Jmax: 7 + Jmax + R].astype(np.int32)
+        self._tends = h[:, 7 + Jmax + R: 7 + Jmax + 2 * R].astype(np.int32)
         self._tpl_lengths_cache = None
 
         # adopt the loop's final device state so the QV sweep reuses it
@@ -1142,6 +1165,21 @@ class BatchPolisher:
         return out
 
     def _consensus_qvs_impl(self, skip) -> list[np.ndarray]:
+        # refine_device leaves per-position integer QVs computed on the
+        # loop's final state (run_qv_ints); serve from that cache when
+        # every live ZMW was live in the cached sweep too.  The cached
+        # reduction ran in f32 on device; the fallback below reduces in
+        # f64 on host -- identical except where the exact QV lands within
+        # f32 rounding of a .5 boundary (a <=1-unit knife-edge, invisible
+        # after the [0, 93] output clamp)
+        cache = getattr(self, "_qv_cache", None)
+        if cache is not None:
+            cached_skip, qv_m = cache
+            live = set(range(self.n_zmws)) - set(skip)
+            if not (live & cached_skip):
+                return [np.zeros(0, np.int32) if z in skip
+                        else qv_m[z, : len(self.tpls[z])].copy()
+                        for z in range(self.n_zmws)]
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
                 for z, t in enumerate(self.tpls[: self.n_zmws])]
@@ -1159,9 +1197,7 @@ class BatchPolisher:
             ssum = np.zeros(len(self.tpls[z]))
             neg = scores[z] < 0.0
             np.add.at(ssum, arrs[z].start[neg], np.exp(scores[z][neg]))
-            prob = 1.0 - 1.0 / (1.0 + ssum)
-            prob = np.maximum(prob, np.finfo(float).tiny)
-            out.append(np.round(-10.0 * np.log10(prob)).astype(np.int32))
+            out.append(mutlib.qvs_from_neg_sums(ssum))
         return out
 
     def _qv_scores_device(self, skip, arrs) -> list[np.ndarray] | None:

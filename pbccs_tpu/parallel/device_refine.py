@@ -39,9 +39,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pbccs_tpu.models.arrow.mutations import (_SLOT_BASES, _SLOT_ENDOFF,
+from pbccs_tpu.models.arrow.mutations import (_LN10 as _MUT_LN10,
+                                              _SLOT_BASES, _SLOT_ENDOFF,
                                               _SLOT_TYPES, DELETION,
-                                              INSERTION, SUBSTITUTION)
+                                              INSERTION, QV_SATURATED,
+                                              SUBSTITUTION)
 from pbccs_tpu.ops.fwdbwd import BandedMatrix
 
 N_SLOTS = 9
@@ -305,7 +307,12 @@ def _chunk_count(jmax: int, chunk: int) -> int:
     return (jmax * N_SLOTS + chunk - 1) // chunk
 
 
-DENSE_EDGE_BUDGET = 128  # edge slab width for the dense (whole-grid) path
+# Edge slab width for the dense (whole-grid) path.  This caps edge-slot
+# columns across the WHOLE grid, where the chunked path's EDGE_BUDGET=64 is
+# per 512-slot chunk (6 chunks at the bench Jmax), so match the chunked
+# path's total capacity -- a smaller whole-grid cap made the dense path bail
+# to the host loop on batches the chunked path handled fine (review r03).
+DENSE_EDGE_BUDGET = 384
 
 
 def slot_geometry(ts, te, strand, ms, me, is_ins):
@@ -521,6 +528,55 @@ def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
     zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
     out = jnp.zeros((Z, M)).at[zidx, pack].set(packed_totals)
     return out, fbs.any()
+
+
+def qv_from_slot_grid(totals: jax.Array, valid: jax.Array) -> jax.Array:
+    """(Z, Jmax) int32 per-position consensus QVs from slot-grid totals.
+
+    Device analogue of mutations.qvs_from_neg_sums (reference ConsensusQVs,
+    Consensus-inl.hpp:277-297): per position, t = logsumexp of the
+    negative-scoring valid slots, QV = -10*(t - softplus(t))/ln 10 =
+    -10*log10(ssum/(1+ssum)); positions with no negative slot saturate to
+    QV_SATURATED.  Slot starts are position-major with start == position
+    for every slot kind (slot_candidates), so the per-position reduction
+    is a reshape."""
+    Z, M = totals.shape
+    sc = jnp.where(valid & (totals < 0.0), totals.astype(jnp.float32),
+                   -jnp.inf).reshape(Z, M // N_SLOTS, N_SLOTS)
+    m = jnp.max(sc, axis=-1)
+    any_neg = jnp.isfinite(m)
+    safe_m = jnp.where(any_neg, m, 0.0)
+    t = safe_m + jnp.log(jnp.sum(jnp.exp(sc - safe_m[..., None]), axis=-1))
+    qv = -10.0 * (t - jax.nn.softplus(t)) / _MUT_LN10
+    return jnp.where(any_neg, jnp.round(qv),
+                     float(QV_SATURATED)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge",
+                                             "dense"))
+def run_qv_ints(state: "RefineLoopState", reads, rlens, strands, table,
+                real_rows, skip_mask, *, chunk: int, min_fast_edge: int,
+                dense: bool = False):
+    """One-dispatch QV sweep reduced to per-position integer QVs on
+    device: (Z, Jmax) int32 + the tiny-window fallback flag.
+
+    Dispatched back-to-back with run_refine_loop (its output state is
+    this function's input, still enqueued -- no host sync between them)
+    so the refine fetch and the QV fetch merge into ONE packed transfer:
+    the separate (Z, 9*Jmax) f32 score fetch moved ~1.5 MB over a
+    ~7 MB/s tunneled link plus a dispatch round trip, for data whose only
+    consumer was the host per-position reduction now done here."""
+    start, end, mtype, base, _ = slot_candidates(state.tpl[0],
+                                                 state.tlens[0])
+    valid = jax.vmap(
+        lambda t, L: slot_candidates(t, L)[4]
+    )(state.tpl, state.tlens)
+    valid &= ~skip_mask[:, None]
+    totals, fb = score_slot_grid(
+        state, reads, rlens, strands, table, real_rows,
+        start, end, mtype, base, valid,
+        chunk=chunk, min_fast_edge=min_fast_edge, dense=dense)
+    return qv_from_slot_grid(totals, valid), fb
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge",
