@@ -17,7 +17,10 @@
 //   CONFIG <n_zmws> <tpl_len> <n_passes> <max_iterations> <min_zscore>
 //   ZMW <id> <snrA> <snrC> <snrG> <snrT> <n_reads>
 //   DRAFT <acgt-string>
-//   READ <strand:0|1> <acgt-string>     (x n_reads)
+//   READ <strand:0|1> <acgt-string>                       (x n_reads)
+//   READWIN <strand:0|1> <tstart> <tend> <acgt-string>    (window variant:
+//       per-read draft window, as the pipeline's POA extents produce;
+//       used by tools/crossval_real.py for real-data cross-validation)
 
 #include <ConsensusCore/Arrow/ArrowConfig.hpp>
 #include <ConsensusCore/Checksum.hpp>
@@ -52,11 +55,17 @@ std::string Checksum::Of(const ArrowSequenceFeatures&) { return "na"; }
 
 namespace {
 
+struct ReadInput {
+    int strand = 0;
+    int tStart = -1, tEnd = -1;  // -1: full draft span (legacy READ lines)
+    std::string seq;
+};
+
 struct ZmwInput {
     std::string id;
     double snr[4];
     std::string draft;
-    std::vector<std::pair<int, std::string>> reads;  // (strand, seq)
+    std::vector<ReadInput> reads;
 };
 
 struct Workload {
@@ -81,9 +90,13 @@ Workload LoadWorkload(const std::string& path)
             std::string t;
             in >> t >> z.draft;                        // DRAFT <seq>
             for (int r = 0; r < nReads; ++r) {
-                int strand; std::string seq;
-                in >> t >> strand >> seq;              // READ <strand> <seq>
-                z.reads.emplace_back(strand, seq);
+                ReadInput ri;
+                in >> t;
+                if (t == "READWIN")                    // READWIN <strand> <ts> <te> <seq>
+                    in >> ri.strand >> ri.tStart >> ri.tEnd >> ri.seq;
+                else                                   // READ <strand> <seq>
+                    in >> ri.strand >> ri.seq;
+                z.reads.push_back(std::move(ri));
             }
             w.zmws.push_back(std::move(z));
         }
@@ -219,16 +232,24 @@ int main(int argc, char** argv)
             ArrowConfig config(ctx, ConsensusCore::Arrow::BandingOptions(12.5));
             ArrowMultiReadMutationScorer mms(config, z.draft);
             for (const auto& sr : z.reads) {
-                ArrowSequenceFeatures features(sr.second);
+                ArrowSequenceFeatures features(sr.seq);
+                int ts = sr.tStart >= 0 ? sr.tStart : 0;
+                int te = sr.tEnd >= 0 ? sr.tEnd
+                                      : static_cast<int>(z.draft.size());
                 MappedArrowRead mr(ArrowRead(features, z.id, "N/A"),
-                                   sr.first ? REVERSE_STRAND : FORWARD_STRAND,
-                                   0, static_cast<int>(z.draft.size()));
+                                   sr.strand ? REVERSE_STRAND : FORWARD_STRAND,
+                                   ts, te);
                 if (mms.AddRead(mr, w.minZScore) != SUCCESS) ++nDroppedReads;
             }
             if (Refine(mms, w.maxIterations, &nTested, &nApplied)) ++nConverged;
-            for (int qv : QvSweep(mms)) { qvSum += qv; ++qvCount; }
-            if (rep == 0 && dump.is_open())
-                dump << z.id << " " << mms.Template() << "\n";
+            std::vector<int> qvs = QvSweep(mms);
+            for (int qv : qvs) { qvSum += qv; ++qvCount; }
+            if (rep == 0 && dump.is_open()) {
+                std::string qstr;  // phred+33, clamped like QVsToASCII
+                for (int qv : qvs)
+                    qstr += static_cast<char>(std::min(std::max(qv, 0), 93) + 33);
+                dump << z.id << " " << mms.Template() << " " << qstr << "\n";
+            }
         }
         auto t1 = std::chrono::steady_clock::now();
         repSecs.push_back(std::chrono::duration<double>(t1 - t0).count());

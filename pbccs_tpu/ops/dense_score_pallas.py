@@ -63,11 +63,22 @@ N_SLOTS = 9
 SUB, INS, DEL = 0, 1, 2
 
 
-def dense_score_enabled() -> bool:
+# Longest padded template the kernel accepts: each grid step holds the
+# read's WHOLE position-indexed refs in VMEM (~5.9 KB/row after lane
+# padding + double buffering), and the scoped-VMEM budget is 16 MB -- a
+# Jmax-5056 bucket OOMed at 29.7 MB.  Longer templates score through the
+# packed-chunk path, whose footprint is Jmax-independent.
+DENSE_MAX_JMAX = 2048
+
+
+def dense_score_enabled(jmax: int | None = None) -> bool:
     """Route full-grid interior scoring through this kernel?
 
     Env override PBCCS_DENSE=1/0; default on for TPU backends, off
-    elsewhere (the packed-chunk JAX path is the CPU reference)."""
+    elsewhere (the packed-chunk JAX path is the CPU reference).  Buckets
+    beyond DENSE_MAX_JMAX always use the chunked path (VMEM footprint)."""
+    if jmax is not None and jmax > DENSE_MAX_JMAX:
+        return False
     env = os.environ.get("PBCCS_DENSE")
     if env is not None:
         return env.strip().lower() not in ("0", "false", "off", "no", "")

@@ -931,7 +931,7 @@ class BatchPolisher:
             separation=opts.mutation_separation,
             neighborhood=opts.mutation_neighborhood,
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
-            dense=dense_score_enabled())
+            dense=dense_score_enabled(self._Jmax))
         # Eager QV sweep on the loop's final state, dispatched back-to-back
         # with the loop program (no host sync between them): consensus_qvs
         # serves from the cached integers, so a refine+QV polish pays ONE
@@ -946,7 +946,7 @@ class BatchPolisher:
             self._shard(self._host_tables), jnp.asarray(self._real_rows),
             jnp.asarray(qv_skip),
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
-            dense=dense_score_enabled())
+            dense=dense_score_enabled(self._Jmax))
         # ONE stacked fetch of every outcome plane (each device->host round
         # trip costs ~0.1-0.25 s over the tunneled link; three sequential
         # fetches here were ~0.5 s of pure latency per polish)
@@ -1223,7 +1223,7 @@ class BatchPolisher:
             self._shard(self._host_tables), jnp.asarray(self._real_rows),
             jnp.asarray(skip_mask),
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
-            dense=dense_score_enabled())
+            dense=dense_score_enabled(self._Jmax))
         stacked = device_fetch(jnp.concatenate(
             [packed, jnp.broadcast_to(fb.astype(packed.dtype),
                                       (1, packed.shape[1]))], axis=0),

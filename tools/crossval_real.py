@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Cross-validate the polish stage on the reference's REAL subread fixture.
+
+Runs the pipeline's own draft stage (filter -> POA -> extract) on the
+m140905 real ZMW (10 subread passes, ~600 bp insert -- the fixture the
+reference uses in tests/TestSparsePoa.cpp:150-170), then polishes the SAME
+prepared inputs two ways:
+
+  1. this framework's BatchPolisher (the TPU path; CPU backend works too);
+  2. the reference's own compiled C++ Arrow implementation
+     (native/refbench, READWIN per-read windows),
+
+and compares the polished consensus bit-for-bit plus the BAM-clamped QV
+strings.  This is the same-draw protocol the simulated cross-validation
+already uses (127/128 bit-identical at round 2), now on real data.
+
+Usage:  python tools/crossval_real.py         # prints a JSON verdict line
+Exit 0 iff the consensus sequences are identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIXTURE = ("/root/reference/tests/data/m140905_042212_sidney_"
+           "c100564852550000001823085912221377_s1_X0.fasta")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFBENCH = os.path.join(REPO, "native", "refbench", "build", "refbench")
+
+
+def prepare():
+    import numpy as np
+
+    from pbccs_tpu.io.fasta import read_fasta
+    from pbccs_tpu.pipeline import (Chunk, ConsensusSettings, Subread,
+                                    prepare_chunk)
+
+    chunk = Chunk("m140905/6251", [], np.full(4, 8.0))
+    for name, seq in read_fasta(FIXTURE):
+        chunk.reads.append(Subread.from_str(name, seq))
+    settings = ConsensusSettings(min_passes=3)
+    failure, prep = prepare_chunk(chunk, settings)
+    assert failure is None, f"draft stage failed: {failure}"
+    return prep, settings
+
+
+def polish_ours(prep, settings):
+    import numpy as np
+
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+
+    task = ZmwTask(prep.chunk.id, prep.css, np.asarray(prep.chunk.snr),
+                   [m.seq for m in prep.mapped],
+                   [m.strand for m in prep.mapped],
+                   [m.tpl_start for m in prep.mapped],
+                   [m.tpl_end for m in prep.mapped])
+    polisher = BatchPolisher([task], min_zscore=settings.min_zscore)
+    res = polisher.refine(settings.refine)
+    qvs = polisher.consensus_qvs()[0]
+    qstr = "".join(chr(min(max(0, int(q)), 93) + 33) for q in qvs)
+    # read windows in the FINAL consensus frame (refinement remaps them
+    # through every applied indel)
+    n = len(prep.mapped)
+    windows = list(zip(polisher._tstarts[0, :n].tolist(),
+                       polisher._tends[0, :n].tolist()))
+    return decode_bases(polisher.tpls[0]), qstr, res[0], windows
+
+
+def polish_reference(prep, settings):
+    from pbccs_tpu.models.arrow.params import decode_bases
+
+    assert os.path.exists(REFBENCH), \
+        f"{REFBENCH} missing: make -C native/refbench"
+    with tempfile.TemporaryDirectory(prefix="crossval_") as tmp:
+        wl = os.path.join(tmp, "workload.txt")
+        dump = os.path.join(tmp, "dump.txt")
+        snr = prep.chunk.snr
+        with open(wl, "w") as f:
+            # both sides MUST run the same refinement budget for the
+            # bit-identity comparison to be meaningful
+            f.write(f"CONFIG 1 {len(prep.css)} {len(prep.mapped)} "
+                    f"{settings.refine.max_iterations} "
+                    f"{settings.min_zscore}\n")
+            f.write(f"ZMW {prep.chunk.id.replace('/', '_')} "
+                    f"{snr[0]} {snr[1]} {snr[2]} {snr[3]} "
+                    f"{len(prep.mapped)}\n")
+            f.write(f"DRAFT {decode_bases(prep.css)}\n")
+            for m in prep.mapped:
+                f.write(f"READWIN {m.strand} {m.tpl_start} {m.tpl_end} "
+                        f"{decode_bases(m.seq)}\n")
+        out = subprocess.run([REFBENCH, wl, "--dump", dump],
+                             capture_output=True, text=True, check=True)
+        stats = json.loads(out.stdout.strip().splitlines()[-1])
+        with open(dump) as f:
+            _, tpl, qstr = f.read().split()
+    return tpl, qstr, stats
+
+
+def main() -> int:
+    prep, settings = prepare()
+    ours, our_q, res, _ = polish_ours(prep, settings)
+    ref, ref_q, stats = polish_reference(prep, settings)
+    seq_equal = ours == ref
+    qv_equal = our_q == ref_q
+    n_qv_diff = (sum(a != b for a, b in zip(our_q, ref_q))
+                 if seq_equal else -1)
+    print(json.dumps({
+        "fixture": os.path.basename(FIXTURE),
+        "n_mapped_reads": len(prep.mapped),
+        "draft_len": len(prep.css),
+        "consensus_len_ours": len(ours),
+        "consensus_len_reference": len(ref),
+        "consensus_identical": seq_equal,
+        "qv_string_identical": qv_equal,
+        "qv_positions_differing": n_qv_diff,
+        "our_converged": res.converged,
+        "reference_converged": stats.get("converged") == 1,
+        "our_mean_qv_clamped": round(sum(ord(c) - 33 for c in our_q)
+                                     / max(len(our_q), 1), 2),
+        "ref_mean_qv_clamped": round(sum(ord(c) - 33 for c in ref_q)
+                                     / max(len(ref_q), 1), 2),
+    }))
+    return 0 if seq_equal else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
