@@ -55,20 +55,19 @@ from pbccs_tpu.models.arrow.params import (
 from pbccs_tpu.ops.fwdbwd import BandedMatrix
 
 _TINY = 1e-30
-_PB = 64          # template positions per kernel step
+_PB = 64          # template positions per kernel grid cell
 _OFF0 = 4         # front padding of every position-indexed input
-_BACKPAD = 12     # back padding (covers p+2 reads at p = Jm-1 plus block pad)
+_HALO = 16        # halo rows per block (offsets span [-3, +2] around _OFF0)
 N_SLOTS = 9
 
 SUB, INS, DEL = 0, 1, 2
 
 
-# Longest padded template the kernel accepts: each grid step holds the
-# read's WHOLE position-indexed refs in VMEM (~5.9 KB/row after lane
-# padding + double buffering), and the scoped-VMEM budget is 16 MB -- a
-# Jmax-5056 bucket OOMed at 29.7 MB.  Longer templates score through the
-# packed-chunk path, whose footprint is Jmax-independent.
-DENSE_MAX_JMAX = 2048
+# Safety cap on the kernel's template length.  VMEM residency is CONSTANT
+# in Jmax (the grid streams halo'd position blocks), so this only bounds
+# the XLA-side halo'd block views (~1.3x the fill tensors) for absurd
+# bucket sizes; every BASELINE.json config sits far below it.
+DENSE_MAX_JMAX = 65536
 
 
 def dense_score_enabled(jmax: int | None = None) -> bool:
@@ -199,12 +198,17 @@ def _hs_scan(b, c, W: int):
 
 def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
                   apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
-                  i_ref, out_ref, *, jm_pad: int, W: int):
-    """Score all 9 slots for _PB template positions per fori step.
+                  i_ref, out_ref, *, W: int):
+    """Score all 9 slots of ONE (read, position-block) grid cell.
 
-    Position-indexed refs are padded so padded[_OFF0 + j] = original[j];
-    every slice below is (_PB, ...) at a static offset from the block
-    start, so the whole step is contiguous VMEM reads + vector math."""
+    Each position-indexed ref is a (_PB + _HALO, n) halo'd block of the
+    padded input (padded[_OFF0 + j] = original[j], block b starting at row
+    b*_PB), so every slice below is (_PB, ...) at a static offset and the
+    whole cell is contiguous VMEM reads + vector math.  Gridding over
+    position blocks (instead of the whole-template fori this kernel used
+    before) keeps VMEM residency CONSTANT in template length -- the
+    whole-template form OOMed the 16 MB scoped budget at a Jmax-5056
+    bucket -- and lets the pipeline stream block loads."""
     hit = 1.0 - MISMATCH_PROBABILITY
     miss = MISMATCH_PROBABILITY / 3.0
     I = i_ref[...]  # (1, 1) int32, broadcasts against (PB, W)
@@ -240,67 +244,74 @@ def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         v = jnp.sum(match + dele, axis=1)
         return jnp.log(jnp.maximum(v, _TINY)) + apre_s[:, 0] + bsuf_b[:, 0]
 
-    def body(blk, _):
-        base = blk * _PB
+    def at(ref, off):
+        return ref[pl.dslice(_OFF0 + off, _PB)]
 
-        def at(ref, off):
-            return ref[pl.dslice(base + _OFF0 + off, _PB)]
+    # shared position-aligned slices
+    a_m1, a_m2 = at(alpha_ref, -1), at(alpha_ref, -2)
+    b_p1, b_p2 = at(beta_ref, 1), at(beta_ref, 2)
+    rb_m1, rb_0, rb_p1 = at(rbase_ref, -1), at(rbase_ref, 0), at(rbase_ref, 1)
+    rn_0, rn_p1 = at(rnext_ref, 0), at(rnext_ref, 1)
+    o_m2, o_m1, o_0 = at(off_ref, -2), at(off_ref, -1), at(off_ref, 0)
+    o_p1, o_p2 = at(off_ref, 1), at(off_ref, 2)
+    ap_m1, ap_0 = at(apre_ref, -1), at(apre_ref, 0)
+    bs_p1, bs_p2 = at(bsuf_ref, 1), at(bsuf_ref, 2)
+    w_m2, w_m1 = at(wtpl_ref, -2), at(wtpl_ref, -1)
+    w_0, w_p1 = at(wtpl_ref, 0), at(wtpl_ref, 1)
+    wt_m3, wt_m2 = at(wtr_ref, -3), at(wtr_ref, -2)
 
-        # shared position-aligned slices
-        a_m1, a_m2 = at(alpha_ref, -1), at(alpha_ref, -2)
-        b_p1, b_p2 = at(beta_ref, 1), at(beta_ref, 2)
-        rb_m1, rb_0, rb_p1 = at(rbase_ref, -1), at(rbase_ref, 0), at(rbase_ref, 1)
-        rn_0, rn_p1 = at(rnext_ref, 0), at(rnext_ref, 1)
-        o_m2, o_m1, o_0 = at(off_ref, -2), at(off_ref, -1), at(off_ref, 0)
-        o_p1, o_p2 = at(off_ref, 1), at(off_ref, 2)
-        ap_m1, ap_0 = at(apre_ref, -1), at(apre_ref, 0)
-        bs_p1, bs_p2 = at(bsuf_ref, 1), at(bsuf_ref, 2)
-        w_m2, w_m1 = at(wtpl_ref, -2), at(wtpl_ref, -1)
-        w_0, w_p1 = at(wtpl_ref, 0), at(wtpl_ref, 1)
-        wt_m3, wt_m2 = at(wtr_ref, -3), at(wtr_ref, -2)
+    outs = [None] * N_SLOTS
+    # ---- SUB + INS slots (s = p): patch = [prev_b, nb] --------------
+    # SUB b and INS b have the IDENTICAL first extend column (same
+    # patched transitions T(prev_b, nb) and same alpha seed); compute
+    # ext0 once per base and branch only on the second column, saving
+    # 4 of the 18 ext_col evaluations per position block.
+    for b in range(4):
+        t0 = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice((b * 2 + 0) * 4, 4)]
+        t1s = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice((b * 2 + 1) * 4, 4)]
+        t1i = pt_ref[pl.dslice(_OFF0, _PB),
+                     pl.dslice((8 + b * 2 + 1) * 4, 4)]
+        nb = jnp.float32(b)
+        ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
+        ext1s = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1s)
+        outs[b] = link(ext1s, o_p1, rn_p1, t1s, w_p1, b_p2,
+                       o_p1 - o_p2, -7, ap_0, bs_p2)
+        ext1i = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1i)
+        outs[4 + b] = link(ext1i, o_p1, rn_p1, t1i, w_0, b_p1,
+                           jnp.zeros_like(o_p1), -1, ap_0, bs_p1)
+    # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
+    t0 = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice(16 * 4, 4)]
+    ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
+                   wt_m3, wt_m2)
+    ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
+    outs[8] = link(ext1, o_0, rn_0, t0, w_p1, b_p2,
+                   o_0 - o_p2, -14, ap_m1, bs_p2)
 
-        outs = [None] * N_SLOTS
-        # ---- SUB + INS slots (s = p): patch = [prev_b, nb] --------------
-        # SUB b and INS b have the IDENTICAL first extend column (same
-        # patched transitions T(prev_b, nb) and same alpha seed); compute
-        # ext0 once per base and branch only on the second column, saving
-        # 4 of the 18 ext_col evaluations per position block.
-        for b in range(4):
-            t0 = pt_ref[pl.dslice(base + _OFF0, _PB),
-                        pl.dslice((b * 2 + 0) * 4, 4)]
-            t1s = pt_ref[pl.dslice(base + _OFF0, _PB),
-                         pl.dslice((b * 2 + 1) * 4, 4)]
-            t1i = pt_ref[pl.dslice(base + _OFF0, _PB),
-                         pl.dslice((8 + b * 2 + 1) * 4, 4)]
-            nb = jnp.float32(b)
-            ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
-            ext1s = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1s)
-            outs[b] = link(ext1s, o_p1, rn_p1, t1s, w_p1, b_p2,
-                           o_p1 - o_p2, -7, ap_0, bs_p2)
-            ext1i = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1i)
-            outs[4 + b] = link(ext1i, o_p1, rn_p1, t1i, w_0, b_p1,
-                               jnp.zeros_like(o_p1), -1, ap_0, bs_p1)
-        # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
-        t0 = pt_ref[pl.dslice(base + _OFF0, _PB), pl.dslice(16 * 4, 4)]
-        ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
-                       wt_m3, wt_m2)
-        ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
-        outs[8] = link(ext1, o_0, rn_0, t0, w_p1, b_p2,
-                       o_0 - o_p2, -14, ap_m1, bs_p2)
-
-        out_ref[pl.dslice(base, _PB)] = jnp.stack(outs, axis=1)
-        return 0
-
-    lax.fori_loop(0, jm_pad // _PB, body, 0)
+    out_ref[...] = jnp.stack(outs, axis=1)
 
 
 def _pad_pos(x, jm_pad: int):
-    """Pad a position-indexed per-read array to (R, _OFF0 + jm_pad +
-    _BACKPAD, ...) rows with zeros so row _OFF0 + j = x[:, j]."""
+    """Pad a position-indexed per-read array so row _OFF0 + j = x[:, j],
+    to (NB + 1) * _PB total rows (one whole trailing block beyond the
+    NB = jm_pad/_PB real blocks, so the halo'd block view below never
+    reads past the end)."""
     n = x.shape[1]
-    total = _OFF0 + jm_pad + _BACKPAD
+    total = (jm_pad // _PB + 1) * _PB
     return jnp.pad(x, [(0, 0), (_OFF0, total - _OFF0 - n)]
                    + [(0, 0)] * (x.ndim - 2))
+
+
+def _halo_blocks(x, jm_pad: int):
+    """(R, NB, _PB + _HALO, n) overlapped position-block view of a padded
+    (R, (NB+1)*_PB, n) input: block b covers padded rows
+    [b*_PB, b*_PB + _PB + _HALO).  Built from two reshapes + a slice, so
+    XLA lowers it to plain copies (no gather)."""
+    R, total = x.shape[:2]
+    n = x.shape[2:]
+    NB = jm_pad // _PB
+    core = x[:, : NB * _PB].reshape((R, NB, _PB) + n)
+    nxt = x[:, _PB: (NB + 1) * _PB].reshape((R, NB, _PB) + n)[:, :, :_HALO]
+    return jnp.concatenate([core, nxt], axis=2)
 
 
 @functools.partial(jax.jit, static_argnames=("width",))
@@ -331,33 +342,38 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     ptrans = jax.vmap(dense_patch_grids)(
         win_tpl.astype(jnp.int32), win_trans, tables, wlens)
 
-    pad = functools.partial(_pad_pos, jm_pad=jm_pad)
-    alpha_p = pad(alpha.vals)
-    beta_p = pad(beta.vals)
-    rbase_p = pad(rbase)
-    rnext_p = pad(rnext)
-    off_p = pad(alpha.offsets[:, :, None].astype(jnp.int32))
-    apre_p = pad(apre[:, :, None].astype(jnp.float32))
-    bsuf_p = pad(bsuf[:, :, None].astype(jnp.float32))
-    wtpl_p = pad(win_tpl[:, :, None].astype(jnp.float32))
-    wtr_p = pad(win_trans)
-    pt_p = pad(ptrans.reshape(R, Jm, 72))
+    def prep(x):
+        return _halo_blocks(_pad_pos(x, jm_pad), jm_pad)
+
+    alpha_p = prep(alpha.vals)
+    beta_p = prep(beta.vals)
+    rbase_p = prep(rbase)
+    rnext_p = prep(rnext)
+    off_p = prep(alpha.offsets[:, :, None].astype(jnp.int32))
+    apre_p = prep(apre[:, :, None].astype(jnp.float32))
+    bsuf_p = prep(bsuf[:, :, None].astype(jnp.float32))
+    wtpl_p = prep(win_tpl[:, :, None].astype(jnp.float32))
+    wtr_p = prep(win_trans)
+    pt_p = prep(ptrans.reshape(R, Jm, 72))
     i_in = rlens[:, None, None].astype(jnp.int32)
 
-    NP = _OFF0 + jm_pad + _BACKPAD
-    kernel = functools.partial(_dense_kernel, jm_pad=jm_pad, W=W)
-    whole = lambda n: pl.BlockSpec((None, NP, n), lambda r: (r, 0, 0))
+    NB = jm_pad // _PB
+    PBH = _PB + _HALO
+    kernel = functools.partial(_dense_kernel, W=W)
+    blk = lambda n: pl.BlockSpec((None, None, PBH, n),
+                                 lambda r, b: (r, b, 0, 0))
     out = pl.pallas_call(
         kernel,
-        grid=(R,),
+        grid=(R, NB),
         in_specs=[
-            whole(W), whole(W), whole(W), whole(W),      # alpha/beta/rb/rn
-            whole(1), whole(1), whole(1),                # off/apre/bsuf
-            whole(1), whole(4),                          # wtpl/wtrans
-            whole(72),                                   # patch trans
-            pl.BlockSpec((None, 1, 1), lambda r: (r, 0, 0)),  # rlen
+            blk(W), blk(W), blk(W), blk(W),              # alpha/beta/rb/rn
+            blk(1), blk(1), blk(1),                      # off/apre/bsuf
+            blk(1), blk(4),                              # wtpl/wtrans
+            blk(72),                                     # patch trans
+            pl.BlockSpec((None, 1, 1), lambda r, b: (r, 0, 0)),  # rlen
         ],
-        out_specs=pl.BlockSpec((None, jm_pad, N_SLOTS), lambda r: (r, 0, 0)),
+        out_specs=pl.BlockSpec((None, _PB, N_SLOTS),
+                               lambda r, b: (r, b, 0)),
         out_shape=jax.ShapeDtypeStruct((R, jm_pad, N_SLOTS), jnp.float32),
         interpret=_interpret(),
     )(

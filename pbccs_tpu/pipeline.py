@@ -435,22 +435,73 @@ def process_chunks(chunks: Sequence[Chunk],
         gate_info = []
         for z, p in enumerate(preps):
             gate_info.append(_read_gates(p, polisher.statuses[z], settings))
-        # ZMWs that shed reads to the alpha/beta mating gate re-run on the
-        # serial path, whose scorer retries the whole ZMW at a 2x band
-        # before dropping (the reference's reband-then-drop semantics,
-        # SimpleRecursor.cpp:642-691); the lockstep batch cannot widen one
-        # ZMW's band without breaking its static shapes
-        reband = {z for z, p in enumerate(preps)
-                  if (polisher.statuses[z, : len(p.mapped)]
-                      == ADD_ALPHABETAMISMATCH).any()}
+        # ZMWs that shed reads to the alpha/beta mating gate retry in ONE
+        # wider-band (2x) sub-batch -- the batched analogue of the serial
+        # scorer's whole-scorer escalation (the reference rebands a
+        # mismatched pair up to 5 times before dropping,
+        # SimpleRecursor.cpp:642-691).  Keep-better-width per ZMW: a ZMW
+        # polishes at the wide band iff it MATES more reads there,
+        # otherwise it stays in the narrow batch with its drops (the
+        # serial retry's revert).  Either way the ZMW stays on the
+        # batched device path.
+        reband = sorted(z for z, p in enumerate(preps)
+                        if (polisher.statuses[z, : len(p.mapped)]
+                            == ADD_ALPHABETAMISMATCH).any())
+        wide = None
+        wide_pick: dict[int, int] = {}
+        if reband:
+            wcfg = dataclasses.replace(
+                polisher.config,
+                banding=dataclasses.replace(
+                    polisher.config.banding,
+                    band_width=2 * polisher.config.banding.band_width))
+            try:  # speculative build: any failure keeps the narrow batch
+                wide = BatchPolisher([tasks[z] for z in reband],
+                                     config=wcfg,
+                                     min_zscore=settings.min_zscore)
+            except Exception:  # noqa: BLE001
+                wide = None
+            if wide is not None:
+                for i, z in enumerate(reband):
+                    nr = len(preps[z].mapped)
+                    n_narrow = int((polisher.statuses[z, :nr]
+                                    != ADD_ALPHABETAMISMATCH).sum())
+                    n_wide = int((wide.statuses[i, :nr]
+                                  != ADD_ALPHABETAMISMATCH).sum())
+                    if n_wide > n_narrow:
+                        wide_pick[z] = i
+                        gate_info[z] = _read_gates(
+                            preps[z], wide.statuses[i], settings)
         # gate-failed ZMWs are excluded from refinement/QV (the serial path
         # returns before polishing them); their batch slots stay idle
-        skip = reband | {z for z, g in enumerate(gate_info)
-                         if g[0] is not None}
+        gate_failed = {z for z, g in enumerate(gate_info) if g[0] is not None}
+        skip = gate_failed | set(wide_pick)
         # z-score statistics are reported for the draft template, before
         # refinement (parity with the serial path)
         global_zs = polisher.global_zscores()
         refine_results = polisher.refine(settings.refine, skip=skip)
+        wide_refine = wide_qvs = wide_gz = None
+        if wide_pick:
+            try:  # the whole wide retry is speculative: any failure in its
+                # polish falls back to the narrow batch's completed results
+                # (with the narrow gates) instead of discarding the batch
+                wide_skip = {i for i in range(wide.n_zmws)
+                             if i not in {wi for z, wi in wide_pick.items()
+                                          if z not in gate_failed}}
+                wide_gz = wide.global_zscores()
+                wide_refine = wide.refine(settings.refine, skip=wide_skip)
+                wide_qvs = wide.consensus_qvs(
+                    skip=wide_skip | {i for i, r in enumerate(wide_refine)
+                                      if not r.converged})
+            except Exception:  # noqa: BLE001
+                for z in list(wide_pick):
+                    gate_info[z] = _read_gates(
+                        preps[z], polisher.statuses[z], settings)
+                wide_pick.clear()
+                gate_failed = {z for z, g in enumerate(gate_info)
+                               if g[0] is not None}
+                skip = gate_failed
+                refine_results = polisher.refine(settings.refine, skip=skip)
         # non-converged ZMWs are discarded by _finish_zmw; don't pay the QV
         # sweep (the most expensive single pass) for them
         skip = skip | {z for z, r in enumerate(refine_results)
@@ -462,31 +513,23 @@ def process_chunks(chunks: Sequence[Chunk],
         # cannot double-count ZMWs when the serial fallback reruns them
         bt = ResultTally()
         for z, p in enumerate(preps):
-            if z in reband:
-                continue  # re-run below with the wider-band serial scorer
             failure, status_counts, n_passes = gate_info[z]
             if failure is not None:
                 bt.tally(failure)
                 continue
             nr = len(p.mapped)
-            failure, result = _finish_zmw(
-                p, settings, polisher.tpls[z], qvs[z], refine_results[z],
-                polisher.zscores[z, :nr], global_zs[z], status_counts,
-                n_passes, p.prep_ms + polish_ms)
-            bt.tally(failure)
-            if result is not None:
-                bt.results.append(result)
-        # rebanded ZMWs reuse their existing prep (the draft stage is not
-        # at fault); only the polish half re-runs, serially.  Note an
-        # alternative would keep these in the batched model via a second
-        # 2x-band BatchPolisher over the reband set; mating drops are rare
-        # enough that the serial path is the simpler sound choice.
-        for z in sorted(reband):
-            try:
-                failure, result = polish_prepared(preps[z], settings)
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
-                bt.tally(Failure.OTHER)
-                continue
+            if z in wide_pick:
+                i = wide_pick[z]
+                failure, result = _finish_zmw(
+                    p, settings, wide.tpls[i], wide_qvs[i], wide_refine[i],
+                    wide.zscores[i, :nr], wide_gz[i], status_counts,
+                    n_passes, p.prep_ms + polish_ms)
+            else:
+                failure, result = _finish_zmw(
+                    p, settings, polisher.tpls[z], qvs[z],
+                    refine_results[z], polisher.zscores[z, :nr],
+                    global_zs[z], status_counts, n_passes,
+                    p.prep_ms + polish_ms)
             bt.tally(failure)
             if result is not None:
                 bt.results.append(result)

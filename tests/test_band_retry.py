@@ -89,15 +89,18 @@ def test_scoring_still_consistent_after_retry_path(rng):
     assert np.isfinite(s1).all()
 
 
-def test_pipeline_reroutes_mating_drops_to_serial(rng, monkeypatch):
-    """A batch ZMW that sheds reads to the mating gate re-runs through the
-    serial path (whose scorer owns the band retry) and still yields.
+def _band_retry_pipeline(rng, monkeypatch, drop_in_wide: bool):
+    """Drive process_chunks with an injected mating drop on rb/1.
 
-    The draft stage usually clips pathological reads before AddRead (their
-    unmatched span falls outside the POA extents), so the gate status is
-    injected at the BatchPolisher to exercise the reroute plumbing."""
+    drop_in_wide=True keeps the drop at BOTH widths (the wide build mates
+    nothing extra -> keep-better-width reverts to the narrow batch);
+    False drops only at the narrow width (the wide build mates more ->
+    rb/1 polishes in the wide sub-batch).  Either way NO ZMW may leave
+    the batched device path for the serial fallback."""
     import pbccs_tpu.parallel.batch as batchmod
-    from pbccs_tpu.pipeline import Chunk, Failure, Subread, process_chunks
+    import pbccs_tpu.pipeline as pipemod
+    from pbccs_tpu.pipeline import Chunk, Subread, process_chunks
+    from pbccs_tpu.pipeline import polish_prepared as orig_polish_prepared
 
     chunks = []
     for z in range(2):
@@ -106,31 +109,61 @@ def test_pipeline_reroutes_mating_drops_to_serial(rng, monkeypatch):
                             [Subread(f"rb/{z}/{i}", r)
                              for i, r in enumerate(reads)], snr))
 
-    serial_ids = []
+    built_widths = []
     orig_polisher = batchmod.BatchPolisher
 
     class DropInjectingPolisher(orig_polisher):
         def __init__(self, tasks, **kw):
             super().__init__(tasks, **kw)
-            # pretend ZMW rb/1's last read failed alpha/beta mating
+            built_widths.append(self._W)
+            narrow = self._W == self.config.banding.band_width \
+                and len(built_widths) == 1
             for z, t in enumerate(tasks):
-                if t.id == "rb/1":
+                if t.id == "rb/1" and (drop_in_wide or narrow):
                     self.statuses[z, len(t.reads) - 1] = \
                         ADD_ALPHABETAMISMATCH
                     self.active[z, len(t.reads) - 1] = False
 
     monkeypatch.setattr(batchmod, "BatchPolisher", DropInjectingPolisher)
 
-    import pbccs_tpu.pipeline as pipemod
-    from pbccs_tpu.pipeline import polish_prepared as orig_polish_prepared
+    serial_ids = []
 
     def tracking_polish_prepared(prep, settings):
         serial_ids.append(prep.chunk.id)
         return orig_polish_prepared(prep, settings)
 
     monkeypatch.setattr(pipemod, "polish_prepared", tracking_polish_prepared)
-
     tally = process_chunks(chunks)
-    assert serial_ids == ["rb/1"]          # only the shedding ZMW rerouted
+    return tally, serial_ids, built_widths
+
+
+def test_pipeline_band_retry_stays_batched_on_revert(rng, monkeypatch):
+    """A mating drop triggers ONE wide (2x) sub-batch build; when the wide
+    build mates nothing extra, the ZMW polishes in the narrow batch with
+    its drop (the serial retry's revert) -- never on the serial path."""
+    from pbccs_tpu.pipeline import Failure
+
+    tally, serial_ids, widths = _band_retry_pipeline(rng, monkeypatch,
+                                                     drop_in_wide=True)
+    assert serial_ids == []
+    assert widths == [96, 192]            # narrow batch + wide retry batch
     assert tally.counts[Failure.SUCCESS] == 2
     assert len(tally.results) == 2
+    rb1 = next(r for r in tally.results if r.id == "rb/1")
+    assert rb1.status_counts[ADD_ALPHABETAMISMATCH] == 1  # kept the drop
+
+
+def test_pipeline_band_retry_picks_wider_band_when_it_mates(rng,
+                                                            monkeypatch):
+    """When the wide build mates more reads, the ZMW's results come from
+    the wide sub-batch (keep-better-width), still on the device path."""
+    from pbccs_tpu.pipeline import Failure
+
+    tally, serial_ids, widths = _band_retry_pipeline(rng, monkeypatch,
+                                                     drop_in_wide=False)
+    assert serial_ids == []
+    assert widths == [96, 192]
+    assert tally.counts[Failure.SUCCESS] == 2
+    rb1 = next(r for r in tally.results if r.id == "rb/1")
+    # the wide build mated every read: the reported statuses carry no drop
+    assert rb1.status_counts[ADD_ALPHABETAMISMATCH] == 0
