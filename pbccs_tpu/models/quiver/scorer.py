@@ -23,12 +23,14 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.params import revcomp
 from pbccs_tpu.models.quiver.params import QuiverConfig
 from pbccs_tpu.models.quiver.recursor import (
+    QuiverFeatureArrays,
     feature_arrays,
     quiver_backward,
     quiver_forward,
     quiver_loglik,
     quiver_loglik_backward,
 )
+from pbccs_tpu.ops.fwdbwd_pallas import fills_use_pallas
 
 from pbccs_tpu.utils import next_pow2 as _next_pow2
 
@@ -71,26 +73,58 @@ class QuiverMultiReadScorer:
             win = revcomp(win)
         return win
 
+    def _stacked_feats(self, idx=None) -> QuiverFeatureArrays:
+        feats = self._dev_feats if idx is None else \
+            [self._dev_feats[i] for i in idx]
+        return QuiverFeatureArrays(*(jnp.stack([getattr(f, n) for f in feats])
+                                     for n in QuiverFeatureArrays._fields))
+
     def _rebuild(self, first: bool) -> None:
         L = len(self.tpl)
         Jmax = _next_pow2(L + 8, 64)
-        lls_a, lls_b = [], []
         self._wins = []
+        wins_np, wlens = [], []
         for r in range(self.n_reads):
             win = self._window_codes(r, self.tpl)
             wpad = np.full(Jmax, 4, np.int8)
             wpad[:len(win)] = win
             self._wins.append((jnp.asarray(wpad), jnp.int32(len(win))))
-            alpha = quiver_forward(self._dev_feats[r], self._rlens[r],
-                                   jnp.asarray(wpad), jnp.int32(len(win)),
-                                   self.config, self._W)
-            beta = quiver_backward(self._dev_feats[r], self._rlens[r],
-                                   jnp.asarray(wpad), jnp.int32(len(win)),
-                                   self.config, self._W)
-            lls_a.append(float(quiver_loglik(alpha, self._rlens[r], len(win))))
-            lls_b.append(float(quiver_loglik_backward(beta, len(win))))
-        ll_a = np.asarray(lls_a)
-        ll_b = np.asarray(lls_b)
+            wins_np.append(wpad)
+            wlens.append(len(win))
+        if fills_use_pallas():
+            # one batched Pallas launch over the read axis (the device
+            # analogue of the reference's per-read SSE recursor,
+            # SseRecursor.cpp:66-130)
+            from pbccs_tpu.models.quiver.pallas_fill import (
+                pallas_quiver_backward_batch, pallas_quiver_forward_batch,
+                quiver_loglik_batch)
+
+            feats = self._stacked_feats()
+            rl = jnp.asarray(self._rlens)
+            tp = jnp.asarray(np.stack(wins_np))
+            tl = jnp.asarray(wlens, jnp.int32)
+            alpha = pallas_quiver_forward_batch(feats, rl, tp, tl,
+                                                self.config, self._W)
+            beta = pallas_quiver_backward_batch(feats, rl, tp, tl,
+                                                self.config, self._W)
+            ll_a = np.asarray(quiver_loglik_batch(alpha, rl, tl), np.float64)
+            jcols = np.arange(beta.log_scales.shape[1])[None, :]
+            ll_b = np.log(np.maximum(np.asarray(beta.vals[:, 0, 0]), 1e-30)) \
+                + np.where(jcols <= np.asarray(tl)[:, None],
+                           np.asarray(beta.log_scales), 0.0).sum(axis=1)
+        else:
+            lls_a, lls_b = [], []
+            for r in range(self.n_reads):
+                wpad, wlen = self._wins[r]
+                alpha = quiver_forward(self._dev_feats[r], self._rlens[r],
+                                       wpad, wlen, self.config, self._W)
+                beta = quiver_backward(self._dev_feats[r], self._rlens[r],
+                                       wpad, wlen, self.config, self._W)
+                lls_a.append(float(quiver_loglik(alpha, self._rlens[r],
+                                                 wlens[r])))
+                lls_b.append(float(quiver_loglik_backward(beta, wlens[r])))
+            ll_a = np.asarray(lls_a)
+            ll_b = np.asarray(lls_b)
         self.baselines = ll_a
         denom = np.where(ll_b == 0, 1.0, ll_b)
         mated = (np.abs(1.0 - ll_a / denom) <= _AB_MISMATCH_TOL) & \
@@ -161,6 +195,22 @@ class QuiverMultiReadScorer:
         wlens_p = np.concatenate([wlens, np.full(Mpad - M, 2, np.int32)])
         feat = self._dev_feats[r]
         rlen = jnp.int32(self._rlens[r])
+        if fills_use_pallas():
+            # the mutated windows ride the kernel's read axis (one read
+            # broadcast against M candidate windows)
+            from pbccs_tpu.models.quiver.pallas_fill import (
+                pallas_quiver_forward_batch, quiver_loglik_batch)
+
+            feats = QuiverFeatureArrays(
+                *(jnp.broadcast_to(t[None], (Mpad,) + t.shape)
+                  for t in feat))
+            rl = jnp.full(Mpad, rlen, jnp.int32)
+            tl = jnp.asarray(wlens_p)
+            alpha = pallas_quiver_forward_batch(feats, rl,
+                                                jnp.asarray(wins_p), tl,
+                                                self.config, self._W)
+            lls = quiver_loglik_batch(alpha, rl, tl)
+            return np.asarray(lls, np.float64)[:M]
 
         def one(win, wlen):
             alpha = quiver_forward(feat, rlen, win, wlen, self.config, self._W)

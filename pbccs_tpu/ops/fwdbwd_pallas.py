@@ -295,28 +295,57 @@ def _shift_right_fill(x, d: int, fill: float):
     return jnp.concatenate([f, x[:, :-d]], axis=1)
 
 
-def _fill_kernel(seed_ref, seedcol_ref, shifts_ref, mask_ref,
-                 cm_ref, cd_ref, cc_ref, vals_ref, ls_ref, prev_ref,
-                 *, jb_size: int, rev_store: bool):
+def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
     """Column scan.  Arrays are in kernel layout (columns, R, W): the column
     axis is the *leading* (untiled) dimension, so the per-column dynamic
     index is plain VMEM address arithmetic.  (Dynamic indexing on the sublane
-    axis of an (R, columns, W) layout measured ~20x slower on v5e.)"""
+    axis of an (R, columns, W) layout measured ~20x slower on v5e.)
+
+    The seed column is injected into b BEFORE the in-column scan: for the
+    Arrow fills the seed columns have zero in-column coefficients so this
+    equals the old post-scan replace, and it additionally serves the Quiver
+    fills, whose seed columns chain the Extra move through the scan
+    (alpha column 0; beta column J below the pin).
+
+    With merge=True (the Quiver recurrence) two extra inputs (shifts2, cg)
+    and two extra scratch slots (prev2, its scale) carry the j-2 Merge
+    operand: b += cg[k] * prev2[k + s2 - 1] / scale_prev
+    (Quiver/SimpleRecursor.cpp merge move; models/quiver/recursor.py)."""
+    if merge:
+        (seed_ref, seedcol_ref, shifts_ref, mask_ref, cm_ref, cd_ref,
+         cc_ref, sh2_ref, cg_ref, vals_ref, ls_ref, prev_ref, prev2_ref,
+         sprev_ref) = refs
+    else:
+        (seed_ref, seedcol_ref, shifts_ref, mask_ref, cm_ref, cd_ref,
+         cc_ref, vals_ref, ls_ref, prev_ref) = refs
     jb = pl.program_id(1)
     seed = seed_ref[...]
     seedcol = seedcol_ref[...]                              # (RB, 1) int32
     RB, W = seed.shape
     u = _UNROLL
 
-    def one_col(prev, jglob, s, cm, cd, cco, m):
-        # band-shift select: vsm1[k] = prev[k + s - 1]; vs = vsm1 shifted 1
+    def one_col(prev, prev2, sprev, jglob, s, cm, cd, cco, m, s2, cg):
+        # band-shift selects: vsm1[k] = prev[k + s - 1], vs[k] = prev[k + s].
+        # vs needs its OWN select: deriving it as vsm1 shifted left by one
+        # zeroes the last lane (vs[W-1] = vsm1[W] = 0 instead of
+        # prev[W-1 + s]), dropping a real in-band contribution whenever
+        # s == 0 -- negligible at the Arrow band edge but a visible error
+        # at the Quiver backward corner (row 0 rides lane W-1).
         vsm1 = jnp.zeros((RB, W), jnp.float32)
+        vs = jnp.zeros((RB, W), jnp.float32)
         for t in range(-1, _MAX_SHIFT):
             vt = _shift_left(prev, t)
             vsm1 = jnp.where(s - 1 == t, vt, vsm1)
-        vs = _shift_left(vsm1, 1)
+            vs = jnp.where(s - 1 == t, _shift_left(prev, t + 1), vs)
 
         b = cm * vsm1 + cd * vs
+        if merge:
+            vgm1 = jnp.zeros((RB, W), jnp.float32)
+            for t in range(-1, 2 * _MAX_SHIFT):
+                vt = _shift_left(prev2, t)
+                vgm1 = jnp.where(s2 - 1 == t, vt, vgm1)
+            b = b + cg * (vgm1 / sprev)
+        b = jnp.where(seedcol == jglob, b + seed, b)
         c = cco
         d = 1
         while d < W:                                        # affine prefix scan
@@ -324,19 +353,26 @@ def _fill_kernel(seed_ref, seedcol_ref, shifts_ref, mask_ref,
             c = c * _shift_right_fill(c, d, 1.0)
             d *= 2
 
-        col = jnp.where(seedcol == jglob, seed, b)
+        col = b
         cmax = jnp.max(col, axis=1, keepdims=True)
         do_scale = m & (cmax > 0)
         scale = jnp.where(do_scale, cmax, 1.0)
         col = jnp.where(m, col / scale, col)
         ls = jnp.where(do_scale, jnp.log(scale), 0.0)
-        return col, ls
+        return col, ls, scale
 
     def body(jc, _):
         base = jc * u
         prev = prev_ref[...]
         # scratch is uninitialized at the first column of each read block
-        prev = jnp.where(jb * jb_size + base == 0, jnp.zeros_like(prev), prev)
+        first = jb * jb_size + base == 0
+        prev = jnp.where(first, jnp.zeros_like(prev), prev)
+        if merge:
+            prev2 = jnp.where(first, jnp.zeros_like(prev), prev2_ref[...])
+            sprev = jnp.where(first, jnp.ones((RB, 1), jnp.float32),
+                              sprev_ref[...])
+            s2_c = sh2_ref[pl.dslice(base, u)]
+            cg_c = cg_ref[pl.dslice(base, u)]
         s_c = shifts_ref[pl.dslice(base, u)]                # (u, RB, 1)
         cm_c = cm_ref[pl.dslice(base, u)]                   # (u, RB, W)
         cd_c = cd_ref[pl.dslice(base, u)]
@@ -346,10 +382,15 @@ def _fill_kernel(seed_ref, seedcol_ref, shifts_ref, mask_ref,
         cols, lss = [], []
         for k in range(u):
             jglob = jb * jb_size + base + k
-            col, ls = one_col(prev, jglob, s_c[k], cm_c[k], cd_c[k],
-                              cc_c[k], m_c[k] > 0)
+            col, ls, scale = one_col(
+                prev, prev2 if merge else None,
+                sprev if merge else None, jglob, s_c[k], cm_c[k],
+                cd_c[k], cc_c[k], m_c[k] > 0,
+                s2_c[k] if merge else None, cg_c[k] if merge else None)
             cols.append(col)
             lss.append(ls)
+            if merge:
+                prev2, sprev = prev, scale
             prev = col
 
         if rev_store:
@@ -360,23 +401,28 @@ def _fill_kernel(seed_ref, seedcol_ref, shifts_ref, mask_ref,
             vals_ref[pl.dslice(base, u)] = jnp.stack(cols)
             ls_ref[pl.dslice(base, u)] = jnp.stack(lss)
         prev_ref[...] = prev
+        if merge:
+            prev2_ref[...] = prev2
+            sprev_ref[...] = sprev
         return 0
 
     lax.fori_loop(0, jb_size // u, body, 0)
 
 
-def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool):
+def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool,
+              shifts2=None, cg=None):
     """Invoke the column-scan kernel.
 
     cm/cd/cc: (R, nc, W); shifts/mask: (R, nc); seed: (R, W); seedcol: (R,).
     Returns vals (R, nc, W) and log-scales (R, nc).  With rev_store, output
-    column t holds kernel column nc-1-t.
-    """
+    column t holds kernel column nc-1-t.  Passing shifts2+cg engages the
+    Merge carry (Quiver recurrence)."""
     R, nc, W = cm.shape
     rb = min(_RB, R)
     jb = min(_JB, nc)
     assert nc % jb == 0 and R % rb == 0
     njb = nc // jb
+    merge = cg is not None
 
     # kernel layout: (columns, R, W) / (columns, R, 1)
     cm_k = jnp.transpose(cm, (1, 0, 2))
@@ -385,7 +431,8 @@ def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool):
     sh_k = jnp.transpose(shifts)[:, :, None]
     mk_k = jnp.transpose(mask)[:, :, None]
 
-    kernel = functools.partial(_fill_kernel, jb_size=jb, rev_store=rev_store)
+    kernel = functools.partial(_fill_kernel, jb_size=jb, rev_store=rev_store,
+                               merge=merge)
     if rev_store:
         col_spec = pl.BlockSpec((jb, rb, W), lambda r, j: (njb - 1 - j, r, 0))
         vec_ospec = pl.BlockSpec((jb, rb, 1), lambda r, j: (njb - 1 - j, r, 0))
@@ -394,26 +441,35 @@ def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool):
         vec_ospec = pl.BlockSpec((jb, rb, 1), lambda r, j: (j, r, 0))
     in_col = pl.BlockSpec((jb, rb, W), lambda r, j: (j, r, 0))
     in_vec = pl.BlockSpec((jb, rb, 1), lambda r, j: (j, r, 0))
+    in_specs = [
+        pl.BlockSpec((rb, W), lambda r, j: (r, 0)),     # seed
+        pl.BlockSpec((rb, 1), lambda r, j: (r, 0)),     # seedcol
+        in_vec,                                          # shifts
+        in_vec,                                          # mask
+        in_col, in_col, in_col,                          # cm, cd, cc
+    ]
+    operands = [seed, seedcol[:, None], sh_k, mk_k, cm_k, cd_k, cc_k]
+    scratch = [pltpu.VMEM((rb, W), jnp.float32)]
+    if merge:
+        in_specs += [in_vec, in_col]                     # shifts2, cg
+        operands += [jnp.transpose(shifts2)[:, :, None],
+                     jnp.transpose(cg, (1, 0, 2))]
+        scratch += [pltpu.VMEM((rb, W), jnp.float32),    # prev2
+                    pltpu.VMEM((rb, 1), jnp.float32)]    # its scale
     vals, ls = pl.pallas_call(
         kernel,
         grid=(R // rb, njb),
-        in_specs=[
-            pl.BlockSpec((rb, W), lambda r, j: (r, 0)),     # seed
-            pl.BlockSpec((rb, 1), lambda r, j: (r, 0)),     # seedcol
-            in_vec,                                          # shifts
-            in_vec,                                          # mask
-            in_col, in_col, in_col,                          # cm, cd, cc
-        ],
+        in_specs=in_specs,
         out_specs=[col_spec, vec_ospec],
         out_shape=[
             jax.ShapeDtypeStruct((nc, R, W), jnp.float32),
             jax.ShapeDtypeStruct((nc, R, 1), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((rb, W), jnp.float32)],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(seed, seedcol[:, None], sh_k, mk_k, cm_k, cd_k, cc_k)
+    )(*operands)
     return jnp.transpose(vals, (1, 0, 2)), jnp.transpose(ls[:, :, 0])
 
 

@@ -68,6 +68,15 @@ EDGE_SLAB = 64
 MIN_FAST_EDGE_WLEN = 8
 
 
+def _jmax_bucket(max_len: int) -> int:
+    """Template-axis bucket: headroom PROPORTIONAL to length, not the old
+    flat +16 -- net insertions during refinement scale with template
+    length, and a 15 kb polish whose templates outgrew a +16 bucket
+    overflow-bailed the device-resident loop every round (straight into
+    the host loop's per-round fetches + length-scaled chunk programs)."""
+    return pad_to(max_len + max(16, max_len // 32), 64)
+
+
 @dataclasses.dataclass
 class ZmwTask:
     """One ZMW's polish-stage inputs (draft template + mapped reads)."""
@@ -325,7 +334,7 @@ class BatchPolisher:
         self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
         self._Imax = pad_to(max((len(r) for t in tasks for r in t.reads),
                                 default=8) + 8, 64)
-        self._Jmax = pad_to(max(len(t.tpl) for t in tasks) + 16, 64)
+        self._Jmax = _jmax_bucket(max(len(t.tpl) for t in tasks))
         self._W = self.config.banding.band_width
 
         Z, R = self._Z, self._R
@@ -836,7 +845,7 @@ class BatchPolisher:
         max_l = max(len(t) for t in self.tpls)
         rebucket = max_l + 2 > self._Jmax
         if rebucket:
-            self._Jmax = pad_to(max_l + 16, 64)  # rebucket (recompiles)
+            self._Jmax = _jmax_bucket(max_l)  # rebucket (recompiles)
         # partial refill when a minority of ZMWs changed (mesh runs always
         # rebuild in full: the compacted sub-batch breaks the sharding)
         if (self.mesh is None and not rebucket

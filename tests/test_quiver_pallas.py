@@ -1,0 +1,135 @@
+"""Typed concordance of the Quiver Pallas fills: Pallas kernel vs the JAX
+banded recursor vs the dense log-space oracle -- the same cross-recursor
+pattern the reference uses to pin its scalar vs SSE Quiver recursors
+(reference ConsensusCore/src/Tests/TestRecursors.cpp:63-69).
+
+The kernel runs in interpret mode on CPU (tests/conftest.py forces the CPU
+backend); on TPU hardware the identical program compiles natively."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbccs_tpu.models.quiver import ALL_MOVES, BASIC_MOVES
+from pbccs_tpu.models.quiver.params import BandingOptions, QuiverConfig
+from pbccs_tpu.models.quiver.pallas_fill import (pallas_quiver_backward_batch,
+                                                 pallas_quiver_forward_batch,
+                                                 quiver_loglik_batch)
+from pbccs_tpu.models.quiver.recursor import (QuiverFeatureArrays,
+                                              dense_loglik, feature_arrays,
+                                              quiver_backward, quiver_forward,
+                                              quiver_loglik,
+                                              quiver_loglik_backward)
+
+from test_quiver import _random_features
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260731)
+
+
+def _stack_feats(fas):
+    return QuiverFeatureArrays(*(jnp.stack([getattr(f, n) for f in fas])
+                                 for n in QuiverFeatureArrays._fields))
+
+
+@pytest.mark.parametrize("moves", [BASIC_MOVES, ALL_MOVES])
+def test_pallas_fills_match_jax_and_oracle(rng, moves):
+    """Batched Pallas alpha/beta fills agree with the JAX banded recursor
+    (tight tolerance: same recurrence, different scan association) and
+    with the dense oracle (banding tolerance), read for read."""
+    W = 48
+    cfg = QuiverConfig(moves_available=moves,
+                       banding=BandingOptions(band_width=W))
+    Imax, Jmax = 128, 64
+    fas, tpls, tlens, rlens, refs = [], [], [], [], []
+    for _ in range(6):
+        J = int(rng.integers(8, 60))
+        tpl = rng.integers(0, 4, J).astype(np.int8)
+        feat = _random_features(rng, tpl)
+        refs.append(dense_loglik(feat, tpl, cfg.qv_params,
+                                 use_merge=bool(moves & 8)))
+        fas.append(feature_arrays(feat, Imax))
+        wpad = np.full(Jmax, 4, np.int8)
+        wpad[:J] = tpl
+        tpls.append(wpad)
+        tlens.append(J)
+        rlens.append(len(feat))
+
+    feat_b = _stack_feats(fas)
+    tpls_b = jnp.asarray(np.stack(tpls))
+    rlens_b = jnp.asarray(rlens, jnp.int32)
+    tlens_b = jnp.asarray(tlens, jnp.int32)
+
+    alpha_b = pallas_quiver_forward_batch(feat_b, rlens_b, tpls_b, tlens_b,
+                                          cfg, W)
+    beta_b = pallas_quiver_backward_batch(feat_b, rlens_b, tpls_b, tlens_b,
+                                          cfg, W)
+    ll_a = np.asarray(quiver_loglik_batch(alpha_b, rlens_b, tlens_b))
+
+    for r in range(len(fas)):
+        a_jax = quiver_forward(fas[r], jnp.int32(rlens[r]),
+                               jnp.asarray(tpls[r]), jnp.int32(tlens[r]),
+                               cfg, W)
+        b_jax = quiver_backward(fas[r], jnp.int32(rlens[r]),
+                                jnp.asarray(tpls[r]), jnp.int32(tlens[r]),
+                                cfg, W)
+        lla_jax = float(quiver_loglik(a_jax, rlens[r], tlens[r]))
+        llb_jax = float(quiver_loglik_backward(b_jax, tlens[r]))
+
+        # cell-level concordance on the live columns
+        J = tlens[r]
+        np.testing.assert_allclose(
+            np.asarray(alpha_b.vals[r, : J + 1]),
+            np.asarray(a_jax.vals[: J + 1]), rtol=2e-4, atol=2e-5,
+            err_msg=f"alpha cells read {r}")
+        np.testing.assert_allclose(
+            np.asarray(beta_b.vals[r, : J + 1]),
+            np.asarray(b_jax.vals[: J + 1]), rtol=2e-4, atol=2e-5,
+            err_msg=f"beta cells read {r}")
+
+        # log-likelihood concordance: Pallas == JAX (tight) == oracle
+        llb_pal = float(
+            np.log(max(beta_b.vals[r, 0, 0], 1e-30))
+            + np.where(np.arange(beta_b.log_scales.shape[1]) <= J,
+                       np.asarray(beta_b.log_scales[r]), 0.0).sum())
+        assert abs(ll_a[r] - lla_jax) < 1e-2, (r, ll_a[r], lla_jax)
+        assert abs(llb_pal - llb_jax) < 1e-2, (r, llb_pal, llb_jax)
+        assert abs(ll_a[r] - refs[r]) < 2e-2, (r, ll_a[r], refs[r])
+        assert abs(llb_pal - refs[r]) < 2e-2, (r, llb_pal, refs[r])
+
+
+def test_pallas_alpha_beta_mate(rng):
+    """Forward and backward Pallas fills of the same pair agree on the
+    total likelihood (the alpha/beta mating identity the scorers gate on)."""
+    W = 48
+    cfg = QuiverConfig(banding=BandingOptions(band_width=W))
+    Imax, Jmax = 128, 64
+    fas, tpls, tlens, rlens = [], [], [], []
+    for _ in range(4):
+        J = int(rng.integers(20, 60))
+        tpl = rng.integers(0, 4, J).astype(np.int8)
+        feat = _random_features(rng, tpl)
+        fas.append(feature_arrays(feat, Imax))
+        wpad = np.full(Jmax, 4, np.int8)
+        wpad[:J] = tpl
+        tpls.append(wpad)
+        tlens.append(J)
+        rlens.append(len(feat))
+    feat_b = _stack_feats(fas)
+    rlens_b = jnp.asarray(rlens, jnp.int32)
+    tlens_b = jnp.asarray(tlens, jnp.int32)
+    tpls_b = jnp.asarray(np.stack(tpls))
+    alpha = pallas_quiver_forward_batch(feat_b, rlens_b, tpls_b, tlens_b,
+                                        cfg, W)
+    beta = pallas_quiver_backward_batch(feat_b, rlens_b, tpls_b, tlens_b,
+                                        cfg, W)
+    ll_a = np.asarray(quiver_loglik_batch(alpha, rlens_b, tlens_b))
+    for r in range(4):
+        J = tlens[r]
+        ll_b = float(
+            np.log(max(beta.vals[r, 0, 0], 1e-30))
+            + np.where(np.arange(beta.log_scales.shape[1]) <= J,
+                       np.asarray(beta.log_scales[r]), 0.0).sum())
+        assert abs(ll_a[r] - ll_b) < 1e-2, (r, ll_a[r], ll_b)
