@@ -53,13 +53,21 @@ def build_tasks(rng, n_zmws: int, tpl_len: int, n_passes: int,
     return tasks, truths
 
 
+def _refine_opts():
+    """The bench's refinement options — shared by the timed workload and
+    the straggler-shape warmup (max_iterations is an executable cache
+    key, so both must agree)."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+
+    return RefineOptions(max_iterations=10)
+
+
 def run_workload(tasks):
     """One full polish: setup + lockstep refinement + QV sweep."""
-    from pbccs_tpu.models.arrow.refine import RefineOptions
     from pbccs_tpu.parallel.batch import BatchPolisher
 
     polisher = BatchPolisher(tasks)
-    results = polisher.refine(RefineOptions(max_iterations=10))
+    results = polisher.refine(_refine_opts())
     qvs = polisher.consensus_qvs()
     return polisher, results, qvs
 
@@ -109,9 +117,17 @@ def bench(n_zmws: int, tpl_len: int, n_passes: int, n_corruptions: int,
     tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corruptions)
 
     t0 = time.monotonic()
-    run_workload(tasks[:batch_size])  # warmup: compiles at bucket shapes
+    pols = [run_workload(tasks[:batch_size])[0]]  # compiles bucket shapes
     if n_zmws % batch_size:           # ragged tail has its own shape
-        run_workload(tasks[-(n_zmws % batch_size):])
+        pols.append(run_workload(tasks[-(n_zmws % batch_size):])[0])
+    # Warm the straggler-continuation shapes of EVERY batch shape (full
+    # and ragged tail): whether a draw produces stragglers is
+    # data-dependent, and their first appearance mid-timing was the
+    # round-3 53x tail-latency outlier (a cold ~1 min XLA compile inside
+    # one timed repeat).
+    for pol in pols:
+        pol.warm_straggler_shapes(_refine_opts())
+    del pols
     warm_s = time.monotonic() - t0
 
     # median of N timed runs: the device link (tunneled on dev hosts) has
@@ -222,8 +238,12 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
                 f.write(f">bench/{z}/{start}_{start + len(seq)}\n{seq}\n")
                 start += len(seq) + 50
     out = os.path.join(tmp, "ccs.bam")
+    # chunked batches so host draft(k+1) overlaps device polish(k) through
+    # the WorkQueue (3 workers: one drafting, one blocked on the device,
+    # one writing back); a single whole-run batch had zero overlap
+    chunk = max(32, n_zmws // 4)
     argv = [out, fasta, "--skipChemistryCheck",
-            "--chunkSize", str(n_zmws), "--zmws", "all",
+            "--chunkSize", str(chunk), "--numThreads", "3", "--zmws", "all",
             "--reportFile", os.path.join(tmp, "ccs_report.csv")]
 
     repeats = int(os.environ.get("BENCH_E2E_REPEATS", 3))

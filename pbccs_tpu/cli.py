@@ -213,7 +213,12 @@ def run(argv: list[str] | None = None) -> int:
             print(f"input file does not exist: {f}", file=sys.stderr)
             return 2
 
-    n_threads = args.numThreads or min(8, os.cpu_count() or 1)
+    # Default to at least 2 workers even on a 1-core host: a worker
+    # blocks on the device with the GIL released for most of a batch
+    # polish, so a second worker drafts the NEXT batch (host POA) during
+    # that wait -- the reference's reader/worker/writer overlap
+    # (ccs.cpp:388-499) re-expressed for a device-bound polish stage.
+    n_threads = args.numThreads or max(2, min(8, os.cpu_count() or 1))
     tally = ResultTally()
 
     # collect movie names for the output header

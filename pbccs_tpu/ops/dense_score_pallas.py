@@ -44,6 +44,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import numpy as np
+
 from pbccs_tpu.models.arrow.params import (
     MISMATCH_PROBABILITY,
     TRANS_BRANCH,
@@ -198,7 +200,7 @@ def _hs_scan(b, c, W: int):
 
 def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
                   apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
-                  i_ref, out_ref, *, W: int):
+                  i_ref, live_ref, out_ref, *, W: int):
     """Score all 9 slots of ONE (read, position-block) grid cell.
 
     Each position-indexed ref is a (_PB + _HALO, n) halo'd block of the
@@ -208,7 +210,26 @@ def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     position blocks (instead of the whole-template fori this kernel used
     before) keeps VMEM residency CONSTANT in template length -- the
     whole-template form OOMed the 16 MB scoped budget at a Jmax-5056
-    bucket -- and lets the pipeline stream block loads."""
+    bucket -- and lets the pipeline stream block loads.
+
+    live_ref gates the whole cell: rounds > 0 of the refinement loop
+    restrict candidates to nearby windows, so most (read, block) cells
+    have no valid slot and skip all compute (their scores are masked
+    downstream; zeros written here are never read)."""
+    @pl.when(live_ref[0, 0, 0] == 0)
+    def _dead():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(live_ref[0, 0, 0] != 0)
+    def _live():
+        _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref,
+                           off_ref, apre_ref, bsuf_ref, wtpl_ref, wtr_ref,
+                           pt_ref, i_ref, out_ref, W=W)
+
+
+def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
+                       apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
+                       i_ref, out_ref, *, W: int):
     hit = 1.0 - MISMATCH_PROBABILITY
     miss = MISMATCH_PROBABILITY / 3.0
     I = i_ref[...]  # (1, 1) int32, broadcasts against (PB, W)
@@ -317,7 +338,8 @@ def _halo_blocks(x, jm_pad: int):
 @functools.partial(jax.jit, static_argnames=("width",))
 def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
                                 tables, alpha: BandedMatrix,
-                                beta: BandedMatrix, apre, bsuf, width: int):
+                                beta: BandedMatrix, apre, bsuf, width: int,
+                                ptrans=None, live=None):
     """(R, Jm, 9) window-frame interior scores for a flat read batch.
 
     reads (R, Imax) int; rlens (R,); win_tpl (R, Jm); win_trans (R, Jm, 4);
@@ -339,8 +361,9 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     rnext = jax.vmap(lambda rf, o: window_rows(rf, o, W))(
         read_f, alpha.offsets)
 
-    ptrans = jax.vmap(dense_patch_grids)(
-        win_tpl.astype(jnp.int32), win_trans, tables, wlens)
+    if ptrans is None:
+        ptrans = jax.vmap(dense_patch_grids)(
+            win_tpl.astype(jnp.int32), win_trans, tables, wlens)
 
     def prep(x):
         return _halo_blocks(_pad_pos(x, jm_pad), jm_pad)
@@ -358,6 +381,12 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     i_in = rlens[:, None, None].astype(jnp.int32)
 
     NB = jm_pad // _PB
+    # trailing (1, 1) dims so the (1, 1) block equals the array's last two
+    # dims (the TPU BlockSpec divisibility rule)
+    if live is None:
+        live_in = jnp.ones((R, NB, 1, 1), jnp.int32)
+    else:
+        live_in = live.astype(jnp.int32)[:, :, None, None]
     PBH = _PB + _HALO
     kernel = functools.partial(_dense_kernel, W=W)
     blk = lambda n: pl.BlockSpec((None, None, PBH, n),
@@ -371,6 +400,7 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
             blk(1), blk(4),                              # wtpl/wtrans
             blk(72),                                     # patch trans
             pl.BlockSpec((None, 1, 1), lambda r, b: (r, 0, 0)),  # rlen
+            pl.BlockSpec((None, 1, 1, 1), lambda r, b: (r, b, 0, 0)),  # live
         ],
         out_specs=pl.BlockSpec((None, _PB, N_SLOTS),
                                lambda r, b: (r, b, 0)),
@@ -378,9 +408,261 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
         interpret=_interpret(),
     )(
         alpha_p, beta_p, rbase_p, rnext_p,
-        off_p, apre_p, bsuf_p, wtpl_p, wtr_p, pt_p, i_in,
+        off_p, apre_p, bsuf_p, wtpl_p, wtr_p, pt_p, i_in, live_in,
     )
     return out[:, :Jm]
+
+
+# --------------------------------------------------------------------------
+# window-frame edge-slot scoring
+#
+# Slots the interior kernel cannot score live at STATIC window-frame
+# positions: near-begin rows {0, 1, 2} and near-end rows {J-2, J-1, J}
+# (sub/del are edge from J-2, ins from J-1; slot_geometry's classification
+# expressed in window frame).  The template-frame edge machinery the dense
+# path previously reused (_batch_edge_fast_totals over a packed edge
+# slab) rebuilt full-window im2cols, neighborhoods and
+# one-hot row-selects per read per round -- ~half of all device time on the
+# round-4 profile.  Here the same extend/link algebra (the edge_scores_fast
+# oracle, reference MutationScorer.cpp:208-231) is evaluated once per read
+# over a (6, 9) window-frame slot grid with STATIC per-slot geometry:
+# every index is either a static slice or one J-relative contiguous
+# dynamic slice, so the whole program is ~7 small column extensions over
+# (R, 27, W) tensors.  Parity: tests/test_dense_score.py fuzzes against
+# edge_scores_fast.
+# --------------------------------------------------------------------------
+
+# static 27-slot tables (3 position rows x 9 slots, slot order = host
+# enumeration: subs A,C,G,T; ins A,C,G,T; del)
+_K27 = np.tile(np.arange(9), 3)
+_Q27 = np.repeat(np.arange(3), 9)
+_SHIFT27 = np.array([0, 0, 0, 0, -1, -1, -1, -1, 1])[_K27]
+_LD27 = -_SHIFT27
+_NEWBASE27 = np.array([0, 1, 2, 3, 0, 1, 2, 3, -1])[_K27]
+_ISDEL27 = (_K27 == 8)
+# near-end replace mask: row J-2 keeps its ins slots (they are interior)
+_NE_MASK9 = np.array([[True] * 4 + [False] * 4 + [True],
+                      [True] * 9,
+                      [True] * 9])
+
+
+def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
+                  *, W: int):
+    """Near-begin scores of one read: (27,) absolute LLs for slots at
+    window positions {0, 1, 2} (rows of pt3).  Mirrors edge_scores_fast's
+    near-begin branch: refill virtual DP columns 1..4 from the pinned
+    start, LinkAlphaBeta at virtual column 4 against saved beta column
+    5 - ld."""
+    from pbccs_tpu.ops.mutation_score import _ext_col, _select_shift
+
+    eps = MISMATCH_PROBABILITY
+    hit, em_miss = 1.0 - eps, eps / 3.0
+    M = 27
+    tplf = tpl.astype(jnp.float32)
+    readf = read.astype(jnp.float32)
+    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(W)])
+    read_pad0 = jnp.concatenate([readf, jnp.zeros(W + 1)])
+    maxl = J + jnp.asarray(_LD27, jnp.int32)
+
+    # per-slot virtual template bases/trans at static absolute window
+    # indices (p, k, shift all static per slot; patch overrides at
+    # p-1 / p; index shift beyond p)
+    def vB(v: int):
+        cols = []
+        for m in range(M):
+            p, k = int(_Q27[m]), int(_K27[m])
+            if v == p - 1:
+                cols.append(tplf[max(p - 1, 0)])
+            elif v == p:
+                if _ISDEL27[m]:
+                    cols.append(tplf[p + 1])
+                else:
+                    cols.append(jnp.float32(_NEWBASE27[m]))
+            else:
+                idx = v + (int(_SHIFT27[m]) if v > p else 0)
+                cols.append(tplf[min(max(idx, 0), tpl.shape[0] - 1)])
+        return jnp.stack(cols)
+
+    def vT(v: int):
+        rows = []
+        for m in range(M):
+            p, k = int(_Q27[m]), int(_K27[m])
+            if v == p - 1:
+                rows.append(pt3[p, k, 0])
+            elif v == p:
+                rows.append(pt3[p, k, 1])
+            else:
+                idx = v + (int(_SHIFT27[m]) if v > p else 0)
+                rows.append(trans[min(max(idx, 0), trans.shape[0] - 1)])
+        return jnp.stack(rows)
+
+    one_col = functools.partial(_ext_col, I=I, max_left=maxl,
+                                hit=hit, em_miss=em_miss, W=W)
+    ext = jnp.zeros((M, W), jnp.float32).at[:, 0].set(1.0)  # alpha(0,0)=1
+    o_prev = offs[0]
+    for j in range(1, 5):
+        o_j = offs[j]
+        rb_j = jnp.broadcast_to(
+            lax.dynamic_slice(read_pad1, (o_j,), (W,)), (M, W))
+        ext = one_col(ext, jnp.broadcast_to(o_j - o_prev, (M,)),
+                      jnp.broadcast_to(o_j, (M,)), rb_j,
+                      jnp.full((M,), j, jnp.int32),
+                      vB(j - 1), vB(j), vT(j - 2), vT(j - 1))
+        o_prev = o_j
+
+    blc = 5 + _SHIFT27                                   # 5 - ld, static
+    B_col = bvals[blc]                                   # (27, W)
+    o_b = boffs[blc]
+    bsuf_b = bsuf[blc]
+    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
+    rows4 = offs[4] + karange
+    link_tr = vT(3)
+    link_b = vB(4)
+    rn4 = jnp.broadcast_to(
+        lax.dynamic_slice(read_pad0, (offs[4],), (W,)), (M, W))
+    em_link = jnp.where(rn4 == link_b[:, None], hit, em_miss)
+    d_b = jnp.broadcast_to(offs[4], (M,)) - o_b
+    beta_ip1 = _select_shift(B_col, d_b + 1, -21, 1)
+    beta_i = _select_shift(B_col, d_b, -22, 0)
+    match = jnp.where(rows4 < I, ext * link_tr[:, TRANS_MATCH][:, None]
+                      * em_link * beta_ip1, 0.0)
+    dele = ext * link_tr[:, TRANS_DARK][:, None] * beta_i
+    v = jnp.sum(match + dele, axis=1)
+    return jnp.log(jnp.maximum(v, _TINY)) + bsuf_b
+
+
+def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
+                  *, W: int):
+    """Near-end scores of one read: (27,) absolute LLs for slots at
+    window positions {J-2, J-1, J}.  Mirrors edge_scores_fast's near-end
+    branch: extend saved alpha columns s..s+2 through the pinned (I, J')
+    corner; LL = log corner + alpha scale prefix.  Geometry is static in
+    the J-relative frame, so every load is one contiguous dynamic slice.
+    Caller guarantees J >= 8 (tiny windows bail to the host path)."""
+    from pbccs_tpu.ops.mutation_score import _ext_col
+
+    eps = MISMATCH_PROBABILITY
+    hit, em_miss = 1.0 - eps, eps / 3.0
+    M = 27
+    nc = avals.shape[0]
+    tplf = tpl.astype(jnp.float32)
+    readf = read.astype(jnp.float32)
+    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(W)])
+    maxl = J + jnp.asarray(_LD27, jnp.int32)
+
+    # J-relative contiguous slices (padded so no dynamic_slice clamping)
+    A5 = lax.dynamic_slice(avals, (J - 4, 0), (5, W))        # cols J-4..J
+    offs_pad = jnp.concatenate([offs, jnp.broadcast_to(offs[nc - 1:], (2,))])
+    offs7 = lax.dynamic_slice(offs_pad, (J - 4,), (7,))      # J-4..J+2
+    apre4 = lax.dynamic_slice(apre, (J - 3,), (4,))          # cols J-3..J
+    tplS = lax.dynamic_slice(
+        jnp.concatenate([tplf, jnp.full(4, 4.0)]), (J - 6,), (10,))
+    transS = lax.dynamic_slice(
+        jnp.concatenate([trans, jnp.zeros((3, 4))]), (J - 6, 0), (9, 4))
+    ptS = lax.dynamic_slice(ptrans, (J - 2, 0, 0, 0), (3, 9, 2, 4))
+    rb6 = jnp.stack([lax.dynamic_slice(read_pad1, (offs7[i],), (W,))
+                     for i in range(1, 7)])                  # cols J-3..J+2
+
+    # t = s - (J-4) in {1..4}, static per slot (s = p - [k==del])
+    t_np = _Q27 + 2 - _ISDEL27.astype(int)
+
+    def pick7(idx_np):
+        return offs7[np.clip(idx_np, 0, 6)]
+
+    o_sm1, o_s = pick7(t_np - 1), pick7(t_np)
+    o_s1, o_s2 = pick7(t_np + 1), pick7(t_np + 2)
+    A_prev = A5[np.clip(t_np - 1, 0, 4)]                     # (27, W)
+    rb_s = rb6[np.clip(t_np - 1, 0, 5)]
+    rb_s1 = rb6[np.clip(t_np, 0, 5)]
+    rb_s2 = rb6[np.clip(t_np + 1, 0, 5)]
+    s_col = J - 4 + jnp.asarray(t_np, jnp.int32)
+    apre_s = apre4[np.clip(t_np - 1, 0, 3)]
+
+    # virtual lookups at J-relative static indices: rel r = v - (J-6);
+    # v queried at s-1..s+2 (bases) and s-2..s+1 (trans), p = J-2+q
+    def vB_rel(dv: int):
+        cols = []
+        for m in range(M):
+            q, k = int(_Q27[m]), int(_K27[m])
+            s_rel = 2 + int(t_np[m])                  # s - (J-6) = t + 2
+            v = s_rel + dv                            # v - (J-6)
+            p_rel = 4 + q                             # p - (J-6)
+            if v == p_rel - 1:
+                cols.append(tplS[p_rel - 1])
+            elif v == p_rel:
+                if _ISDEL27[m]:
+                    cols.append(tplS[p_rel + 1])
+                else:
+                    cols.append(jnp.float32(_NEWBASE27[m]))
+            else:
+                idx = v + (int(_SHIFT27[m]) if v > p_rel else 0)
+                cols.append(tplS[min(max(idx, 0), 9)])
+        return jnp.stack(cols)
+
+    def vT_rel(dv: int):
+        rows = []
+        for m in range(M):
+            q, k = int(_Q27[m]), int(_K27[m])
+            s_rel = 2 + int(t_np[m])
+            v = s_rel + dv
+            p_rel = 4 + q
+            if v == p_rel - 1:
+                rows.append(ptS[q, k, 0])
+            elif v == p_rel:
+                rows.append(ptS[q, k, 1])
+            else:
+                idx = v + (int(_SHIFT27[m]) if v > p_rel else 0)
+                rows.append(transS[min(max(idx, 0), 8)])
+        return jnp.stack(rows)
+
+    one_col = functools.partial(_ext_col, I=I, max_left=maxl,
+                                hit=hit, em_miss=em_miss, W=W)
+    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s_col,
+                   vB_rel(-1), vB_rel(0), vT_rel(-2), vT_rel(-1))
+    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s_col + 1,
+                   vB_rel(0), vB_rel(1), vT_rel(-1), vT_rel(0))
+    ext2 = one_col(ext1, o_s2 - o_s1, o_s2, rb_s2, s_col + 2,
+                   vB_rel(1), vB_rel(2), vT_rel(0), vT_rel(1))
+
+    kstar = maxl - s_col                                     # 1 or 2
+    corner_vals = jnp.where((kstar == 1)[:, None], ext1, ext2)
+    o_corner = jnp.where(kstar == 1, o_s1, o_s2)
+    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
+    corner = jnp.sum(jnp.where(karange == (I - o_corner)[:, None],
+                               corner_vals, 0.0), axis=1)
+    return jnp.log(jnp.maximum(corner, _TINY)) + apre_s
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def edge_window_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
+                             alpha: BandedMatrix, beta: BandedMatrix,
+                             apre, bsuf, ptrans, width: int):
+    """(R, 6, 9) window-frame edge-slot scores: rows 0..2 = window
+    positions {0, 1, 2} (near-begin), rows 3..5 = {J-2, J-1, J}
+    (near-end).  Entries whose slot is actually interior (ins at J-2) or
+    invalid are garbage the caller masks/splices around."""
+    def one(read, I, tpl, trans, J, avals, aoffs, bvals, boffs, ap, bs, pt):
+        nb = _edge_nb_read(read, I, tpl, trans, J, aoffs, bvals, boffs,
+                           bs, pt[:3], W=width)
+        ne = _edge_ne_read(read, I, tpl, trans, J, avals, aoffs, ap, pt,
+                           W=width)
+        return jnp.concatenate([nb.reshape(3, 9), ne.reshape(3, 9)])
+
+    return jax.vmap(one)(reads.astype(jnp.int32), rlens.astype(jnp.int32),
+                         win_tpl.astype(jnp.int32), win_trans,
+                         wlens.astype(jnp.int32),
+                         alpha.vals, alpha.offsets.astype(jnp.int32),
+                         beta.vals, beta.offsets.astype(jnp.int32),
+                         apre, bsuf, ptrans)
+
+
+def splice_edge_rows(grid, e6, J):
+    """Overwrite one read's window-frame grid rows {0,1,2, J-2,J-1,J}
+    with the edge scores (ins at J-2 keeps its interior-kernel value)."""
+    grid = lax.dynamic_update_slice(grid, e6[:3], (0, 0))
+    cur = lax.dynamic_slice(grid, (J - 2, 0), (3, 9))
+    upd = jnp.where(jnp.asarray(_NE_MASK9), e6[3:], cur)
+    return lax.dynamic_update_slice(grid, upd, (J - 2, 0))
 
 
 # --------------------------------------------------------------------------

@@ -318,7 +318,16 @@ class BatchPolisher:
     def __init__(self, tasks: Sequence[ZmwTask],
                  config: ArrowConfig | None = None,
                  min_zscore: float = float("nan"),
-                 mesh: Mesh | None = None):
+                 mesh: Mesh | None = None, *,
+                 buckets: tuple[int, int, int] | None = None,
+                 min_z: int = 1):
+        """`buckets` = (Imax, Jmax, R) lower bounds and `min_z` a ZMW-axis
+        lower bound: sub-batches carved out of a parent batch (straggler
+        continuations, wide-band retries) pin their shapes to the parent's
+        buckets and a pow2 Z so the compiled-program menu is bounded --
+        letting each draw's straggler count pick its own shapes compiled a
+        fresh ~minute-long device loop mid-bench (the round-3 53x
+        tail-latency outlier)."""
         if not tasks:
             raise ValueError("empty batch")
         self.config = config or ArrowConfig()
@@ -330,11 +339,23 @@ class BatchPolisher:
 
         zq = mesh.shape[ZMW_AXIS] if mesh else 1
         rq = mesh.shape[READ_AXIS] if mesh else 1
-        self._Z = pad_to(self.n_zmws, zq)
+        self._Z = pad_to(max(self.n_zmws, min_z), zq)
         self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
         self._Imax = pad_to(max((len(r) for t in tasks for r in t.reads),
                                 default=8) + 8, 64)
-        self._Jmax = _jmax_bucket(max(len(t.tpl) for t in tasks))
+        max_l = max(len(t.tpl) for t in tasks)
+        self._Jmax = _jmax_bucket(max_l)
+        if buckets is not None:
+            self._Imax = max(self._Imax, buckets[0])
+            self._R = max(self._R, buckets[2])
+            # adopt the parent's Jmax bucket EXACTLY when templates fit:
+            # letting _jmax_bucket of a mid-refinement template overshoot
+            # the parent bucket would mint a fresh draw-dependent shape
+            # (a cold compile, the very thing buckets exist to prevent)
+            if max_l + 2 <= buckets[1]:
+                self._Jmax = buckets[1]
+            else:
+                self._Jmax = max(self._Jmax, buckets[1])
         self._W = self.config.banding.band_width
 
         Z, R = self._Z, self._R
@@ -1028,22 +1049,17 @@ class BatchPolisher:
         sub_budget = (budget - max(results[z].iterations
                                    for z in stragglers)) if stragglers else 0
         if stragglers and sub_budget > 0 and self.n_zmws > len(stragglers):
-            sub_tasks = []
-            for z in stragglers:
-                rows = np.nonzero(self._real_rows[z])[0]
-                sub_tasks.append(ZmwTask(
-                    f"straggler/{z}", self.tpls[z].copy(), self._snrs[z],
-                    [self._reads[z, r, : self._rlens[z, r]].copy()
-                     for r in rows],
-                    [int(self._strands[z, r]) for r in rows],
-                    [int(self._tstarts[z, r]) for r in rows],
-                    [int(self._tends[z, r]) for r in rows]))
             # the continuation carries the REMAINING round budget (total
             # iterations across parent + sub match the host loop and the
             # reference's single max_iterations bound); the static
             # max_iterations stays the executable-cache key, the spent
-            # rounds ride in as the dynamic initial round counter
-            sub = BatchPolisher(sub_tasks, config=self.config)
+            # rounds ride in as the dynamic initial round counter.
+            # Shapes pin to the parent's buckets + ONE canonical Z (the
+            # pow2 of the loop's straggler-exit threshold, an upper bound
+            # on the straggler count) so every draw's straggler set --
+            # whatever its size -- reuses the same compiled programs
+            # (_straggler_sub; pre-warmable via warm_straggler_shapes).
+            sub = self._straggler_sub(stragglers)
             # parent gating carries over; the sub-polisher must not re-gate
             # (it sees mid-refinement templates, not the draft).  The live
             # read-active mask is on device (host copy is the AddRead-time
@@ -1068,6 +1084,47 @@ class BatchPolisher:
             self._stale_fills = True  # parent fills for straggler rows are
             # pre-continuation; a later refine() must rebuild (see above)
         return results
+
+    def straggler_shape_min_z(self) -> int:
+        """The canonical ZMW-axis size of this polisher's straggler
+        continuation sub-batches (device_refine.run_refine_loop exits
+        early once <= Z//32 ZMWs remain; the sub-batch pads to this one
+        pow2 size so its compiled shapes are draw-independent)."""
+        return next_pow2(max(self._Z // 32, 1), 4)
+
+    def _straggler_sub(self, zmws: Sequence[int]) -> "BatchPolisher":
+        """Construct the canonical straggler-continuation sub-batch for
+        the given parent rows — ONE shape recipe shared by the live
+        continuation (refine_device) and warm_straggler_shapes, so the
+        pre-warm compiles exactly the executables the continuation uses."""
+        sub_tasks = []
+        for z in zmws:
+            rows = np.nonzero(self._real_rows[z])[0]
+            sub_tasks.append(ZmwTask(
+                f"straggler/{z}", self.tpls[z].copy(), self._snrs[z],
+                [self._reads[z, r, : self._rlens[z, r]].copy()
+                 for r in rows],
+                [int(self._strands[z, r]) for r in rows],
+                [int(self._tstarts[z, r]) for r in rows],
+                [int(self._tends[z, r]) for r in rows]))
+        return BatchPolisher(sub_tasks, config=self.config,
+                             buckets=(self._Imax, self._Jmax, self._R),
+                             min_z=self.straggler_shape_min_z())
+
+    def warm_straggler_shapes(self, opts: RefineOptions | None = None
+                              ) -> None:
+        """Compile the straggler-continuation shapes ahead of timed work.
+
+        Whether a batch produces stragglers is data-dependent; their first
+        appearance used to cold-compile a ~minute-long device loop inside
+        a timed run (the round-3 53x tail-latency outlier).  `opts` must
+        match the opts later passed to refine() -- max_iterations is part
+        of the executable cache key."""
+        if self._Z // 32 < 1 or self.n_zmws < 1:
+            return  # this Z has no straggler early exit
+        sub = self._straggler_sub([0])
+        sub.refine(opts)
+        sub.consensus_qvs()
 
     def refine(self, opts: RefineOptions | None = None,
                skip=None, budget: int | None = None) -> list[RefineResult]:

@@ -307,14 +307,6 @@ def _chunk_count(jmax: int, chunk: int) -> int:
     return (jmax * N_SLOTS + chunk - 1) // chunk
 
 
-# Edge slab width for the dense (whole-grid) path.  This caps edge-slot
-# columns across the WHOLE grid, where the chunked path's EDGE_BUDGET=64 is
-# per 512-slot chunk (6 chunks at the bench Jmax), so match the chunked
-# path's total capacity -- a smaller whole-grid cap made the dense path bail
-# to the host loop on batches the chunked path handled fine (review r03).
-DENSE_EDGE_BUDGET = 384
-
-
 def slot_geometry(ts, te, strand, ms, me, is_ins):
     """Interior-vs-edge classification of mutation slots against read
     windows (ONE definition, shared by the chunked and dense scoring
@@ -336,11 +328,15 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     Pallas dense kernel (ops/dense_score_pallas) -- one whole-grid pass
     with VMEM-resident intermediates instead of the chunk scan whose
     materialized (Z, R, chunk, W) intermediates made the packed path
-    HBM-bound (docs/PROFILE_r03.md) -- and edge mutations pack into one
-    DENSE_EDGE_BUDGET slab across the full grid."""
+    HBM-bound (docs/PROFILE_r03.md).  Edge slots live at STATIC
+    window-frame rows ({0,1,2} and {J-2,J-1,J}), so they are scored by
+    the small window-frame edge program (edge_window_scores_batch) and
+    spliced into the kernel grid before the orientation mapping -- the
+    whole grid then maps and reduces in one pass, with no packed edge
+    slab, no edge budget, and no template-frame edge machinery."""
     from pbccs_tpu.ops.dense_score_pallas import (
-        dense_interior_scores_batch, window_grid_to_template)
-    from pbccs_tpu.parallel import batch as batchmod
+        dense_interior_scores_batch, dense_patch_grids,
+        edge_window_scores_batch, splice_edge_rows, window_grid_to_template)
 
     Z, R = reads.shape[:2]
     jmax = st.tpl.shape[1]
@@ -352,58 +348,60 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
         start[None, None, :], end[None, None, :],
         (mtype == INSERTION)[None, None, :])
     geo = valid[:, None, :] & overlap & real_rows[:, :, None]
-    int_mask = geo & interior & st.active[:, :, None]
-    edge_mask = geo & ~interior
-    fb = (edge_mask & (wlen < min_fast_edge)).any()
+    # tiny windows (wlen < min_fast_edge) cannot ride the window-frame
+    # edge program (its two regimes would overlap); bail to the host loop
+    fb = (geo & ~interior & (wlen < min_fast_edge)).any()
 
-    # interior: dense kernel in window frame, then per-read orientation map
     flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
     tables = flat(jnp.broadcast_to(table[:, None], (Z, R) + table.shape[1:]))
     W = st.alpha.vals.shape[-1]
+    f_reads, f_rlens = flat(reads), flat(rlens)
+    f_wt, f_wtr, f_wl = flat(st.win_tpl), flat(st.win_trans), flat(st.wlens)
+    alpha_f = BandedMatrix(flat(st.alpha.vals), flat(st.alpha.offsets),
+                           flat(st.alpha.log_scales))
+    beta_f = BandedMatrix(flat(st.beta.vals), flat(st.beta.offsets),
+                          flat(st.beta.log_scales))
+    f_apre, f_bsuf = flat(st.a_prefix), flat(st.b_suffix)
+    ptrans = jax.vmap(dense_patch_grids)(f_wt.astype(jnp.int32), f_wtr,
+                                         tables, f_wl)
+    # (read, position-block) live mask: rounds > 0 restrict candidates to
+    # nearby windows, so most kernel grid cells have no valid slot and
+    # can skip all compute.  A block is live iff any valid candidate
+    # POSITION maps into its window rows (over-approximated by +-1 to
+    # cover the ins/subdel row offset in the reverse frame).
+    from pbccs_tpu.ops.dense_score_pallas import _PB
+    NB = -(-jmax // _PB)
+    pos_any = valid.reshape(Z, jmax, N_SLOTS).any(-1)
+    pref = jnp.concatenate(
+        [jnp.zeros((Z, 1), jnp.int32),
+         jnp.cumsum(pos_any.astype(jnp.int32), axis=1)], axis=1)
+    b = jnp.arange(NB, dtype=jnp.int32)[None, None, :]
+    ts3, te3 = st.tstarts[:, :, None], st.tends[:, :, None]
+    lo_f, hi_f = ts3 + b * _PB, ts3 + (b + 1) * _PB
+    lo_r, hi_r = te3 - (b + 1) * _PB - 1, te3 - b * _PB + 1
+    fwd3 = strands[:, :, None] == 0
+    lo = jnp.clip(jnp.where(fwd3, lo_f, lo_r) - 1, 0, jmax)
+    hi = jnp.clip(jnp.where(fwd3, hi_f, hi_r) + 1, 0, jmax)
+    take = lambda idx: jnp.take_along_axis(
+        pref, idx.reshape(Z, -1), axis=1).reshape(Z, R, NB)
+    live = ((take(hi) - take(lo)) > 0) & real_rows[:, :, None] \
+        & st.active[:, :, None]
     grid_w = dense_interior_scores_batch(
-        flat(reads), flat(rlens), flat(st.win_tpl), flat(st.win_trans),
-        flat(st.wlens), tables,
-        BandedMatrix(flat(st.alpha.vals), flat(st.alpha.offsets),
-                     flat(st.alpha.log_scales)),
-        BandedMatrix(flat(st.beta.vals), flat(st.beta.offsets),
-                     flat(st.beta.log_scales)),
-        flat(st.a_prefix), flat(st.b_suffix), W)
+        f_reads, f_rlens, f_wt, f_wtr, f_wl, tables, alpha_f, beta_f,
+        f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB))
+    e6 = edge_window_scores_batch(f_reads, f_rlens, f_wt, f_wtr, f_wl,
+                                  alpha_f, beta_f, f_apre, f_bsuf,
+                                  ptrans, W)
+    grid_w = jax.vmap(splice_edge_rows)(grid_w, e6, f_wl.astype(jnp.int32))
     mapped = jax.vmap(
         lambda g, s, a, b: window_grid_to_template(g, s, a, b, jmax)
     )(grid_w, flat(strands), flat(st.tstarts), flat(st.tends))
     mapped = mapped.reshape(Z, R, M)
-    int_tot = jnp.sum(
-        jnp.where(int_mask, mapped - st.baselines[:, :, None], 0.0), axis=1)
-
-    # edge: one packed slab across the full grid
-    eb = DENSE_EDGE_BUDGET
-    e_ok = edge_mask & (wlen >= min_fast_edge) & st.active[:, :, None]
-    em_any = e_ok.any(axis=1)                                # (Z, M)
-    e_over = em_any.sum(axis=1).max() > eb
-    order = jnp.argsort(~em_any, axis=1, stable=True)[:, :eb]
-    packed = jnp.take_along_axis(em_any, order, axis=1)
-    gm = lambda a: jnp.take_along_axis(
-        jnp.broadcast_to(a[None, :], (Z, M)), order, axis=1)
-    ge_mask = jnp.take_along_axis(
-        e_ok, order[:, None, :].repeat(R, 1), axis=2)
-    g_end = gm(end)
-    g_base = gm(base)
-    tpl32 = st.tpl.astype(jnp.int32)
-    tpl32_r = st.tpl_r.astype(jnp.int32)
-    edge_packed = batchmod._batch_edge_fast_totals.__wrapped__(
-        reads, rlens, strands, st.tstarts, st.tends,
-        st.win_tpl, st.win_trans, st.wlens,
-        st.alpha.vals, st.alpha.offsets, st.alpha.log_scales,
-        st.beta.vals, st.beta.offsets, st.beta.log_scales,
-        st.a_prefix, st.b_suffix, st.baselines,
-        tpl32, st.trans_f, tpl32_r, st.trans_r, table, st.tlens,
-        gm(start), g_end, gm(mtype), g_base,
-        st.tlens[:, None] - g_end,
-        jnp.where(g_base < 0, -1, 3 - g_base),
-        ge_mask, st.active)
-    zidx = jnp.arange(Z, dtype=jnp.int32)[:, None]
-    out = int_tot.at[zidx, order].add(jnp.where(packed, edge_packed, 0.0))
-    return out, fb | e_over
+    score_mask = geo & st.active[:, :, None]
+    out = jnp.sum(
+        jnp.where(score_mask, mapped - st.baselines[:, :, None], 0.0),
+        axis=1)
+    return out, fb
 
 
 def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,

@@ -440,10 +440,14 @@ def process_chunks(chunks: Sequence[Chunk],
         # scorer's whole-scorer escalation (the reference rebands a
         # mismatched pair up to 5 times before dropping,
         # SimpleRecursor.cpp:642-691).  Keep-better-width per ZMW: a ZMW
-        # polishes at the wide band iff it MATES more reads there,
-        # otherwise it stays in the narrow batch with its drops (the
-        # serial retry's revert).  Either way the ZMW stays on the
-        # batched device path.
+        # polishes at the wide band iff it MATES more reads there
+        # (status != ALPHABETAMISMATCH -- deliberately counting reads the
+        # wide band mates but the z-score gate then drops: the reference
+        # rebands to achieve alpha/beta agreement FIRST and applies the
+        # z-score gate to whatever mated, so reband-to-mate-then-gate is
+        # the parity semantics, not mates-that-survive-gating).  Otherwise
+        # it stays in the narrow batch with its drops (the serial retry's
+        # revert).  Either way the ZMW stays on the batched device path.
         reband = sorted(z for z, p in enumerate(preps)
                         if (polisher.statuses[z, : len(p.mapped)]
                             == ADD_ALPHABETAMISMATCH).any())
@@ -456,9 +460,17 @@ def process_chunks(chunks: Sequence[Chunk],
                     polisher.config.banding,
                     band_width=2 * polisher.config.banding.band_width))
             try:  # speculative build: any failure keeps the narrow batch
+                from pbccs_tpu.utils import next_pow2
+
+                # pin shapes to the narrow batch's buckets + pow2 Z so the
+                # data-dependent reband count doesn't mint fresh compiles
                 wide = BatchPolisher([tasks[z] for z in reband],
                                      config=wcfg,
-                                     min_zscore=settings.min_zscore)
+                                     min_zscore=settings.min_zscore,
+                                     buckets=(polisher._Imax,
+                                              polisher._Jmax,
+                                              polisher._R),
+                                     min_z=next_pow2(len(reband), 4))
             except Exception:  # noqa: BLE001
                 wide = None
             if wide is not None:
@@ -494,6 +506,7 @@ def process_chunks(chunks: Sequence[Chunk],
                     skip=wide_skip | {i for i, r in enumerate(wide_refine)
                                       if not r.converged})
             except Exception:  # noqa: BLE001
+                retry = set(wide_pick)
                 for z in list(wide_pick):
                     gate_info[z] = _read_gates(
                         preps[z], polisher.statuses[z], settings)
@@ -501,7 +514,17 @@ def process_chunks(chunks: Sequence[Chunk],
                 gate_failed = {z for z, g in enumerate(gate_info)
                                if g[0] is not None}
                 skip = gate_failed
-                refine_results = polisher.refine(settings.refine, skip=skip)
+                # refine ONLY the formerly wide-routed ZMWs: the rest of
+                # the narrow batch already refined in the first pass, and
+                # re-running them would hand non-convergent ZMWs a second
+                # full iteration budget and rebuild their refine stats
+                todo = retry - gate_failed
+                if todo:
+                    retry_results = polisher.refine(
+                        settings.refine,
+                        skip=set(range(polisher.n_zmws)) - todo)
+                    for z in todo:
+                        refine_results[z] = retry_results[z]
         # non-converged ZMWs are discarded by _finish_zmw; don't pay the QV
         # sweep (the most expensive single pass) for them
         skip = skip | {z for z, r in enumerate(refine_results)

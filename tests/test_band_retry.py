@@ -137,6 +137,7 @@ def _band_retry_pipeline(rng, monkeypatch, drop_in_wide: bool):
     return tally, serial_ids, built_widths
 
 
+@pytest.mark.slow
 def test_pipeline_band_retry_stays_batched_on_revert(rng, monkeypatch):
     """A mating drop triggers ONE wide (2x) sub-batch build; when the wide
     build mates nothing extra, the ZMW polishes in the narrow batch with
@@ -153,6 +154,7 @@ def test_pipeline_band_retry_stays_batched_on_revert(rng, monkeypatch):
     assert rb1.status_counts[ADD_ALPHABETAMISMATCH] == 1  # kept the drop
 
 
+@pytest.mark.slow
 def test_pipeline_band_retry_picks_wider_band_when_it_mates(rng,
                                                             monkeypatch):
     """When the wide build mates more reads, the ZMW's results come from

@@ -36,6 +36,7 @@ def corrupt(rng, tpl):
     return out
 
 
+@pytest.mark.slow
 def test_batch_scores_match_per_zmw_scorer(rng):
     tasks, _ = make_tasks(rng, n_zmws=2, tpl_len=60, n_passes=4)
     batch = BatchPolisher(tasks)
@@ -67,6 +68,7 @@ def test_batch_refine_recovers_templates(rng):
     assert all(q.mean() > 10 for q in qvs)
 
 
+@pytest.mark.slow
 def test_batch_sharded_matches_unsharded(rng):
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
     tasks, _ = make_tasks(rng, n_zmws=4, tpl_len=60, n_passes=4)
@@ -116,6 +118,7 @@ def test_batch_global_zscores_finite(rng):
     assert np.isfinite(gz).all()
 
 
+@pytest.mark.slow
 def test_partial_refill_matches_full(rng):
     """Refilling only changed ZMWs after apply_mutations produces the same
     templates, QVs, and convergence as the always-full rebuild."""
@@ -152,6 +155,7 @@ def test_partial_refill_matches_full(rng):
         assert res_full[z].converged == res_part[z].converged
 
 
+@pytest.mark.slow
 def test_tiny_window_fallback_matches_per_zmw(rng):
     """Reads whose template window is shorter than MIN_FAST_EDGE_WLEN score
     boundary mutations by full refill (the fallback pair path); decisions

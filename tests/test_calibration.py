@@ -17,6 +17,7 @@ native/refbench/README.md).
 """
 
 import numpy as np
+import pytest
 
 from pbccs_tpu.align.pairwise import align as nw_align
 from pbccs_tpu.models.arrow.params import decode_bases
@@ -31,6 +32,7 @@ def _polish_workload(n_zmws, tpl_len, n_passes, seed):
     return p, truths, qvs
 
 
+@pytest.mark.slow
 def test_qv_calibration_binned():
     Z, L = 16, 150
     p, truths, qvs = _polish_workload(Z, L, 8, 456)
@@ -71,6 +73,7 @@ def test_qv_calibration_binned():
     assert e_high <= max(1, n_high // 2000)  # and essentially error-free
 
 
+@pytest.mark.slow
 def test_predicted_accuracy_tracks_realized():
     Z, L = 12, 150
     p, truths, qvs = _polish_workload(Z, L, 8, 789)

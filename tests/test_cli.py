@@ -31,6 +31,7 @@ def make_zmw_records(rng, movie, hole, tpl_len=60, n_passes=4):
     return tpl, recs, snr
 
 
+@pytest.mark.slow
 def test_cli_fasta_end_to_end(rng, tmp_path):
     fasta = str(tmp_path / "subreads.fasta")
     records = []
@@ -61,6 +62,7 @@ def test_cli_fasta_end_to_end(rng, tmp_path):
     assert "Success -- CCS generated,2," in text
 
 
+@pytest.mark.slow
 def test_cli_bam_input_with_chemistry(rng, tmp_path):
     in_bam = str(tmp_path / "subreads.bam")
     movie = "m140905_042212_sidney_c100564852550000001823085912221377_s1_X0"

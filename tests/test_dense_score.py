@@ -134,6 +134,7 @@ def _dense_grid(case, r):
     [(1, 0, 60), (1, 0, 60)],              # reverse, full window
     [(0, 5, 56), (1, 3, 58)],              # clipped windows, both strands
 ])
+@pytest.mark.slow
 def test_dense_matches_packed_interior(rng, windows):
     case = _setup_case(rng, 60, 2, windows)
     for r in range(len(windows)):
@@ -146,6 +147,7 @@ def test_dense_matches_packed_interior(rng, windows):
                                    err_msg=f"read {r} windows={windows}")
 
 
+@pytest.mark.slow
 def test_qv_grid_dense_matches_chunked(rng):
     """End-to-end: run_qv_grid with dense=True (kernel in interpret mode)
     produces the same packed slot scores as the chunked path on a real
@@ -174,6 +176,76 @@ def test_qv_grid_dense_matches_chunked(rng):
     assert bool(fb_c) == bool(fb_d)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(chunked),
                                rtol=2e-5, atol=2e-3)
+
+
+@pytest.mark.parametrize("windows", [
+    [(0, 0, 60), (1, 0, 60)],              # full windows, both strands
+    [(0, 5, 56), (1, 3, 58)],              # clipped windows
+    [(0, 0, 17), (1, 40, 60)],             # short-ish windows (>= 8)
+])
+@pytest.mark.slow
+def test_edge_window_scores_match_oracle(rng, windows):
+    """The window-frame edge program equals edge_scores_fast (the oracle
+    that is itself pinned to the full-refill path in test_mutation_fast)
+    on every near-begin/near-end slot of every read."""
+    from pbccs_tpu.ops.mutation_score import edge_scores_fast
+
+    case = _setup_case(rng, 60, 2, windows)
+    R = case["reads"].shape[0]
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    ptrans = jax.vmap(dsp.dense_patch_grids)(
+        case["win_tpl"].astype(jnp.int32), case["win_trans"], tables,
+        case["wlens"])
+    e6 = np.asarray(dsp.edge_window_scores_batch(
+        case["reads"], case["rlens"], case["win_tpl"], case["win_trans"],
+        case["wlens"], case["alpha"], case["beta"], case["apre"],
+        case["bsuf"], ptrans, W))
+
+    for r in range(R):
+        J = int(case["wlens"][r])
+        win_tpl = case["win_tpl"][r].astype(np.int32)
+        win_trans = case["win_trans"][r]
+        # oracle inputs: window-frame slot list for the 6 edge rows
+        for row, p in enumerate([0, 1, 2, J - 2, J - 1, J]):
+            for k in range(9):
+                mtype = [0, 0, 0, 0, 1, 1, 1, 1, 2][k]
+                nbase = [0, 1, 2, 3, 0, 1, 2, 3, -1][k]
+                # validity in window frame: position exists on the window
+                # template; del/sub need p < J, ins allows p <= J; skip
+                # slots whose regime the edge program does not serve
+                if mtype == 1:
+                    if p > J or (row == 3):     # ins at J-2 is interior
+                        continue
+                else:
+                    if p >= J:
+                        continue
+                if p <= 2 and row >= 3:
+                    continue                     # tiny-window overlap
+                from pbccs_tpu.ops.mutation_score import make_patches_fast
+                patch = make_patches_fast(
+                    jnp.asarray(win_tpl), win_trans, case["table"],
+                    jnp.asarray(J, jnp.int32),
+                    jnp.asarray([p], jnp.int32),
+                    jnp.asarray([mtype], jnp.int32),
+                    jnp.asarray([max(nbase, 0)], jnp.int32))
+                want = float(np.asarray(edge_scores_fast(
+                    case["reads"][r].astype(jnp.int32), case["rlens"][r],
+                    jnp.asarray(win_tpl), win_trans,
+                    jnp.asarray(J, jnp.int32),
+                    BandedMatrix(case["alpha"].vals[r],
+                                 case["alpha"].offsets[r],
+                                 case["alpha"].log_scales[r]),
+                    BandedMatrix(case["beta"].vals[r],
+                                 case["beta"].offsets[r],
+                                 case["beta"].log_scales[r]),
+                    case["apre"][r], case["bsuf"][r],
+                    jnp.asarray([p], jnp.int32),
+                    jnp.asarray([mtype], jnp.int32),
+                    patch.bases, patch.trans, patch.shift))[0])
+                got = float(e6[r, row, k])
+                np.testing.assert_allclose(
+                    got, want, rtol=2e-5, atol=2e-3,
+                    err_msg=f"read {r} row {row} p={p} k={k} J={J}")
 
 
 def test_dense_patch_grids_match_make_patches(rng):

@@ -85,6 +85,7 @@ def test_greedy_matches_best_subset(rng):
         assert got_keys == want_keys, trial
 
 
+@pytest.mark.slow
 def test_greedy_peel_matches_scan(rng):
     """The data-parallel peeling selection equals the sequential-scan
     greedy on randomized slot grids, including adversarial cases: equal
@@ -184,6 +185,7 @@ def test_greedy_separation_zero_dedupes_per_start(rng):
     np.testing.assert_array_equal(taken, [False, True, False, True, False])
 
 
+@pytest.mark.slow
 def test_device_loop_matches_host_loop(rng, monkeypatch):
     """End-to-end: the device-resident while_loop refinement produces
     bit-identical templates, QVs, and counters to the host loop."""
@@ -221,6 +223,48 @@ def test_device_loop_matches_host_loop(rng, monkeypatch):
         np.testing.assert_array_equal(qh[z], qd[z])
 
 
+@pytest.mark.slow
+def test_device_loop_dense_matches_host_loop(rng, monkeypatch):
+    """The dense-kernel scoring path (PBCCS_DENSE=1, interpret mode on
+    CPU) drives the device loop to the same refinement outcome as the
+    host loop: same convergence, same templates, same QVs.  Exercises the
+    live-block skip (rounds > 0 restrict candidates to nearby windows,
+    so most kernel cells are dead) and the window-frame edge splice."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    tasks = []
+    for z in range(3):
+        tpl, reads, strands, snr = simulate_zmw(rng, 70, 5)
+        draft = tpl.copy()
+        draft[35] = (draft[35] + 1) % 4
+        if z == 1:
+            draft = np.delete(draft, 2)     # near-begin edge mutation
+        if z == 2:
+            draft[len(draft) - 2] = (draft[len(draft) - 2] + 2) % 4  # near-end
+        tasks.append(ZmwTask(f"dd/{z}", draft, snr, reads, strands,
+                             [0] * 5, [len(draft)] * 5))
+    opts = RefineOptions(max_iterations=8)
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "0")
+    host = BatchPolisher(tasks)
+    rh = host.refine(opts)
+    qh = host.consensus_qvs()
+
+    monkeypatch.setenv("PBCCS_DEVICE_REFINE", "1")
+    monkeypatch.setenv("PBCCS_DENSE", "1")
+    dev = BatchPolisher(tasks)
+    rd = dev.refine(opts)
+    qd = dev.consensus_qvs()
+
+    for z in range(3):
+        assert rh[z].converged and rd[z].converged
+        np.testing.assert_array_equal(host.tpls[z], dev.tpls[z])
+        np.testing.assert_array_equal(qh[z], qd[z])
+
+
+@pytest.mark.slow
 def test_device_loop_skip_and_empty(rng, monkeypatch):
     """skip ZMWs stay untouched and non-converged through the device loop."""
     from pbccs_tpu.models.arrow.refine import RefineOptions
@@ -244,6 +288,7 @@ def test_device_loop_skip_and_empty(rng, monkeypatch):
     np.testing.assert_array_equal(p.tpls[1], before)
 
 
+@pytest.mark.slow
 def test_straggler_continuation_plumbing(rng, monkeypatch):
     """The straggler early-exit path: a ZMW the loop returns unconverged
     with budget left is finished in a compact sub-polisher, its template

@@ -10,6 +10,7 @@ gracefully, not catastrophically.
 """
 
 import numpy as np
+import pytest
 
 from pbccs_tpu.align.pairwise import align as nw_align
 from pbccs_tpu.models.arrow.params import decode_bases, revcomp
@@ -53,6 +54,7 @@ def _lengthen_homopolymers(rng, read: np.ndarray, p: float = 0.3) -> np.ndarray:
     return np.concatenate(parts)
 
 
+@pytest.mark.slow
 def test_bursty_reads_converge_gracefully(rng):
     chunks, truths = [], []
     for z in range(3):
@@ -74,6 +76,7 @@ def test_bursty_reads_converge_gracefully(rng):
         assert 0.5 < res.predicted_accuracy <= 1.0
 
 
+@pytest.mark.slow
 def test_homopolymer_bias_degrades_gracefully(rng):
     chunks, truths = [], []
     for z in range(3):

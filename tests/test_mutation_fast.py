@@ -53,6 +53,7 @@ def test_make_patches_fast_matches_make_patch(zmw_state):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_interior_fast_matches_extend_link(zmw_state):
     """Interior mutation LLs from the batched scorer equal the per-mutation
     extend+link reference, per read, on interior-mask positions."""
@@ -90,6 +91,7 @@ def test_interior_fast_matches_extend_link(zmw_state):
         assert diff.max() < 2e-3, (r, diff.max())
 
 
+@pytest.mark.slow
 def test_edge_fast_matches_full_refill(zmw_state):
     """Boundary-mutation LLs from the short extension programs equal the
     full banded refill of the mutated window, per read (the reference's

@@ -137,6 +137,7 @@ def test_pipeline_rejects_invalid_bases():
     assert result is None
 
 
+@pytest.mark.slow
 def test_process_chunks_tally(rng):
     chunks = []
     for i in range(3):
@@ -152,6 +153,7 @@ def test_process_chunks_tally(rng):
     assert ids == {"movie/1", "movie/2"}
 
 
+@pytest.mark.slow
 def test_batch_polish_matches_serial(rng):
     """The lockstep batched polish path produces the same consensus,
     QVs, gates, and yield counts as the serial per-ZMW path."""

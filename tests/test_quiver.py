@@ -99,6 +99,7 @@ def test_config_table_alias_and_fallback():
     assert table.at("P6-C4").qv_params.chemistry == "default"
 
 
+@pytest.mark.slow
 def test_scorer_recovers_corrupted_template(rng):
     J = 60
     tpl = rng.integers(0, 4, J).astype(np.int8)

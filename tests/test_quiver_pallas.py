@@ -34,6 +34,7 @@ def _stack_feats(fas):
                                  for n in QuiverFeatureArrays._fields))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("moves", [BASIC_MOVES, ALL_MOVES])
 def test_pallas_fills_match_jax_and_oracle(rng, moves):
     """Batched Pallas alpha/beta fills agree with the JAX banded recursor

@@ -20,6 +20,7 @@ pytestmark = pytest.mark.skipif(not os.path.exists(FIXTURE),
                                 reason="reference fixture unavailable")
 
 
+@pytest.mark.slow
 def test_ccs_on_real_zmw(tmp_path):
     from pbccs_tpu.cli import run
     from pbccs_tpu.io.fasta import read_fasta
