@@ -348,38 +348,49 @@ std::vector<int32_t> AddFirstRead(Graph& g, const int8_t* read, int32_t n) {
 // Shared k-mer (cssPos, readPos) seeds via a sorted (hash, pos) table over
 // the css; homopolymer k-mers and k-mers occurring > kMaxOcc times in the
 // css are masked (reference HpHasher + FilterSeeds intent).
-void FindSeeds(const std::vector<int8_t>& css, const std::vector<int8_t>& read,
-               int32_t k, std::vector<int32_t>* sh, std::vector<int32_t>* sv) {
-  constexpr int32_t kMaxOcc = 64;
+
+std::vector<int64_t> KmerHashes(const std::vector<int8_t>& s, int32_t k) {
   const int64_t mask = (int64_t(1) << (2 * k)) - 1;
-  auto hashes = [&](const std::vector<int8_t>& s) {
-    std::vector<int64_t> h(s.size() >= size_t(k) ? s.size() - k + 1 : 0, -1);
-    int64_t cur = 0;
-    int32_t valid = 0;
-    for (size_t i = 0; i < s.size(); ++i) {
-      if (s[i] < 0 || s[i] > 3) {
-        valid = 0;
-        cur = 0;
-      } else {
-        cur = ((cur << 2) | s[i]) & mask;
-        ++valid;
-      }
-      if (valid >= k && i + 1 >= size_t(k)) h[i + 1 - k] = cur;
+  std::vector<int64_t> h(s.size() >= size_t(k) ? s.size() - k + 1 : 0, -1);
+  int64_t cur = 0;
+  int32_t valid = 0;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] < 0 || s[i] > 3) {
+      valid = 0;
+      cur = 0;
+    } else {
+      cur = ((cur << 2) | s[i]) & mask;
+      ++valid;
     }
-    return h;
-  };
+    if (valid >= k && i + 1 >= size_t(k)) h[i + 1 - k] = cur;
+  }
+  return h;
+}
+
+// Sorted (hash, cssPos) table -- built ONCE per read and shared by the
+// forward/RC orientation seed searches (it depends only on the css).
+std::vector<std::pair<int64_t, int32_t>> SeedTable(
+    const std::vector<int8_t>& css, int32_t k) {
+  auto h1 = KmerHashes(css, k);
+  std::vector<std::pair<int64_t, int32_t>> table;
+  table.reserve(h1.size());
+  for (size_t i = 0; i < h1.size(); ++i)
+    if (h1[i] >= 0) table.emplace_back(h1[i], static_cast<int32_t>(i));
+  std::sort(table.begin(), table.end());
+  return table;
+}
+
+void FindSeedsInTable(const std::vector<std::pair<int64_t, int32_t>>& table,
+                      const std::vector<int8_t>& read, int32_t k,
+                      std::vector<int32_t>* sh, std::vector<int32_t>* sv) {
+  constexpr int32_t kMaxOcc = 64;
   std::vector<int64_t> hp(4);  // homopolymer hashes
   for (int64_t b = 0; b < 4; ++b) {
     int64_t v = 0;
     for (int32_t j = 0; j < k; ++j) v = (v << 2) | b;
     hp[b] = v;
   }
-  auto h1 = hashes(css), h2 = hashes(read);
-  std::vector<std::pair<int64_t, int32_t>> table;
-  table.reserve(h1.size());
-  for (size_t i = 0; i < h1.size(); ++i)
-    if (h1[i] >= 0) table.emplace_back(h1[i], static_cast<int32_t>(i));
-  std::sort(table.begin(), table.end());
+  auto h2 = KmerHashes(read, k);
   for (size_t j = 0; j < h2.size(); ++j) {
     int64_t h = h2[j];
     if (h < 0 || h == hp[0] || h == hp[1] || h == hp[2] || h == hp[3])
@@ -395,6 +406,7 @@ void FindSeeds(const std::vector<int8_t>& css, const std::vector<int8_t>& read,
     }
   }
 }
+
 
 // Longest strictly-increasing (cssPos, readPos) subsequence of the seeds:
 // the banding anchor chain, O(n log n) patience LIS.  Mirror of
@@ -546,18 +558,58 @@ Plan TryAddRead(const Graph& g, const std::vector<int32_t>& topo,
     int32_t* bd = &p.dpred[p.off[v]];
     const auto& plist = g.preds[v].empty() ? kNoPred : g.preds[v];
     for (int32_t pr : plist) {
-      for (int32_t i = std::max(lo, 1); i < hi; ++i) {
-        float sub = read[i - 1] == vb ? kMatch : kMismatch;
-        float m = (pr < 0 ? 0.0f : p.Cell(pr, i - 1)) + sub;
+      // Segmented band fill: the predecessor's Cell() is a plain array
+      // read inside its band [plo, phi) and a constant 0 outside, so
+      // split each loop into (below, in-band, above) segments and drop
+      // the per-cell bounds branches -- this loop pair is the native
+      // POA's hottest code (gprof: ~60% of orient_add).
+      const int32_t plo = pr < 0 ? 0 : p.lo[pr];
+      const int32_t phi = pr < 0 ? 0 : p.hi[pr];
+      const float* pc = pr < 0 ? nullptr : &p.cols[p.off[pr]];
+      const int32_t a = std::max(lo, 1);
+      // match: pred cell (i - 1), in-band for i in [plo + 1, phi + 1)
+      const int32_t a1 = pc ? std::max(a, plo + 1) : hi;
+      const int32_t b1 = pc ? std::min(hi, phi + 1) : hi;
+      for (int32_t i = a; i < std::min(a1, hi); ++i) {
+        float m = read[i - 1] == vb ? kMatch : kMismatch;
         if (m > best_m[i]) {
           best_m[i] = m;
           bm[i - lo] = pr;
         }
       }
-      for (int32_t i = lo; i < hi; ++i) {
-        float d = (pr < 0 ? 0.0f : p.Cell(pr, i)) + kDelete;
+      for (int32_t i = a1; i < b1; ++i) {
+        float m = pc[i - 1 - plo] + (read[i - 1] == vb ? kMatch : kMismatch);
+        if (m > best_m[i]) {
+          best_m[i] = m;
+          bm[i - lo] = pr;
+        }
+      }
+      for (int32_t i = std::max(b1, a); i < hi; ++i) {
+        float m = read[i - 1] == vb ? kMatch : kMismatch;
+        if (m > best_m[i]) {
+          best_m[i] = m;
+          bm[i - lo] = pr;
+        }
+      }
+      // delete: pred cell (i), in-band for i in [plo, phi)
+      const int32_t c1 = pc ? std::max(lo, plo) : hi;
+      const int32_t d1 = pc ? std::min(hi, phi) : hi;
+      for (int32_t i = lo; i < std::min(c1, hi); ++i) {
+        if (kDelete > best_d[i]) {
+          best_d[i] = kDelete;
+          bd[i - lo] = pr;
+        }
+      }
+      for (int32_t i = c1; i < d1; ++i) {
+        float d = pc[i - plo] + kDelete;
         if (d > best_d[i]) {
           best_d[i] = d;
+          bd[i - lo] = pr;
+        }
+      }
+      for (int32_t i = std::max(d1, lo); i < hi; ++i) {
+        if (kDelete > best_d[i]) {
+          best_d[i] = kDelete;
           bd[i - lo] = pr;
         }
       }
@@ -735,9 +787,10 @@ int32_t pbccs_poa_orient_add(void* h, const int8_t* read, int32_t n,
       css_seq[i] = g->base[css_path[i]];
     const int32_t k = (css_seq.size() < 1000 && fwd.size() < 1000) ? 6 : 10;
     std::vector<int32_t> fh, fv, rh, rv;
-    poa::FindSeeds(css_seq, fwd, k, &fh, &fv);
+    auto table = poa::SeedTable(css_seq, k);   // shared by both strands
+    poa::FindSeedsInTable(table, fwd, k, &fh, &fv);
     poa::AnchorChain(&fh, &fv);
-    poa::FindSeeds(css_seq, rev, k, &rh, &rv);
+    poa::FindSeedsInTable(table, rev, k, &rh, &rv);
     poa::AnchorChain(&rh, &rv);
     // Orientation triage by chain density (see poa/sparse.py): a much
     // thinner chain marks the (almost surely) wrong strand, which gets a

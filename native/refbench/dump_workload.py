@@ -28,9 +28,11 @@ def main() -> None:
     from bench import build_tasks
     from pbccs_tpu.models.arrow.params import decode_bases
 
+    from bench import parse_passes
+
     n_zmws = int(os.environ.get("BENCH_ZMWS", 128))
     tpl_len = int(os.environ.get("BENCH_TPL_LEN", 300))
-    n_passes = int(os.environ.get("BENCH_PASSES", 8))
+    n_passes = os.environ.get("BENCH_PASSES", "8")   # "8" or "3-10" range
     n_corr = int(os.environ.get("BENCH_CORRUPTIONS", 2))
     iters = int(os.environ.get("REFBENCH_ITERS", 10))
     min_z = float(os.environ.get("REFBENCH_MIN_ZSCORE", -5.0))
@@ -41,7 +43,11 @@ def main() -> None:
     tasks, _truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corr)
 
     with open(out_path, "w") as f:
-        f.write(f"CONFIG {n_zmws} {tpl_len} {n_passes} {iters} {min_z}\n")
+        # the CONFIG passes field is informational (per-ZMW read counts
+        # ride the ZMW lines); write the range's low end as the int the
+        # C++ parser expects
+        f.write(f"CONFIG {n_zmws} {tpl_len} {parse_passes(n_passes)[0]} "
+                f"{iters} {min_z}\n")
         for t in tasks:
             f.write(f"ZMW {t.id.replace(' ', '_')} "
                     f"{t.snr[0]} {t.snr[1]} {t.snr[2]} {t.snr[3]} "

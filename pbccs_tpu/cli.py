@@ -81,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TRACE..FATAL. Default = %(default)s")
     p.add_argument("--reportFile", default="ccs_report.csv",
                    help="Where to write the yield report. Default = %(default)s")
+    p.add_argument("--model", choices=("arrow", "quiver"), default="arrow",
+                   help="Polish model family (default: arrow, the ccs "
+                        "model; quiver is the QV-feature model -- reads "
+                        "without QV tracks use flat default tracks).")
     p.add_argument("--skipChemistryCheck", action="store_true",
                    help="Accept non-P6-C4 read groups (required for FASTA "
                         "input, which carries no chemistry metadata).")
@@ -205,7 +209,8 @@ def run(argv: list[str] | None = None) -> int:
         min_snr=args.minSnr,
         min_predicted_accuracy=args.minPredictedAccuracy,
         min_zscore=args.minZScore,
-        max_drop_fraction=args.maxDropFraction)
+        max_drop_fraction=args.maxDropFraction,
+        model=args.model)
 
     files = flatten_fofn(args.files)
     for f in files:

@@ -80,6 +80,23 @@ def refine_consensus(scorer: ArrowMultiReadScorer,
     return res
 
 
+def consensus_qvs(scorer) -> np.ndarray:
+    """Per-position consensus QVs from a full single-mutation sweep against
+    the scorer's current template (reference ConsensusQVs,
+    Consensus-inl.hpp:277-297).  Generic over the scorer interface
+    (tpl / score_mutations), mirroring the reference's implementation
+    being templated over Arrow and Quiver scorers (Consensus.hpp:64-79);
+    ArrowMultiReadScorer keeps its own batched method, Quiver delegates
+    here."""
+    muts = mutlib.enumerate_unique(scorer.tpl)
+    scores = np.asarray(scorer.score_mutations(muts), np.float64)
+    ssum = np.zeros(len(scorer.tpl))
+    neg = scores < 0.0
+    starts = np.asarray([m.start for m in muts], np.int64)
+    np.add.at(ssum, starts[neg], np.exp(scores[neg]))
+    return mutlib.qvs_from_neg_sums(ssum)
+
+
 def predicted_accuracy(qvs: np.ndarray) -> float:
     """1 - mean per-base error probability (reference Consensus.h:506-512)."""
     if len(qvs) == 0:

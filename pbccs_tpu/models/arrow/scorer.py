@@ -590,17 +590,9 @@ class ArrowMultiReadScorer:
     # ------------------------------------------------------------------- QVs
 
     def consensus_qvs(self) -> np.ndarray:
-        """Per-position QVs from single-base mutation scores.
+        """Per-position QVs from single-base mutation scores, via the
+        generic sweep shared with Quiver (models.arrow.refine.consensus_qvs;
+        reference ConsensusQVs, Consensus-inl.hpp:277-297)."""
+        from pbccs_tpu.models.arrow.refine import consensus_qvs
 
-        Parity: ConsensusQVs (reference Consensus-inl.hpp:277-297): only
-        negative-scoring mutations contribute exp(score); QV =
-        -10*log10(ssum/(1+ssum)) via the shared stable aggregation
-        (mutations.qvs_from_neg_sums)."""
-        tpl = self.tpl
-        muts = mutlib.enumerate_unique(tpl)
-        scores = self.score_mutations(muts)
-        score_sum = np.zeros(len(tpl))
-        for m, s in zip(muts, scores):
-            if s < 0.0:
-                score_sum[m.start] += np.exp(s)
-        return mutlib.qvs_from_neg_sums(score_sum)
+        return consensus_qvs(self)

@@ -48,3 +48,13 @@ class QvSequenceFeatures:
 
     def __len__(self) -> int:
         return len(self.seq)
+
+
+def flat_default_features(seq: np.ndarray) -> QvSequenceFeatures:
+    """Features for a read WITHOUT QV tracks: zero QVs (param-only move
+    scores) and an 'N' del-tag (never matches a template base) -- the
+    fallback the quiver pipeline/bench use for plain-sequence subreads."""
+    codes = np.asarray(seq, np.int8)
+    n = len(codes)
+    z = np.zeros(n, np.float32)
+    return QvSequenceFeatures(codes, z, z, z, np.full(n, 4, np.float32), z)

@@ -38,6 +38,35 @@ ADD_SUCCESS, ADD_ALPHABETAMISMATCH = 0, 1
 _AB_MISMATCH_TOL = 1e-3
 _MUT_CHUNK = 256
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("config", "width"))
+def _lls_program(feats, rl, tp, tl, *, config, width):
+    """(rows,) forward log-likelihoods of a flat (read, window) batch via
+    the XLA recursor — ONE jitted program (eager per-op dispatch over a
+    tunneled device link costs ~0.1 s per op; a whole polish ran minutes
+    of pure dispatch latency before this was jitted)."""
+    def one(feat, rlen, win, wlen):
+        alpha = quiver_forward(feat, rlen, win, wlen, config, width)
+        return quiver_loglik(alpha, rlen, wlen)
+
+    return jax.vmap(one)(feats, rl, tp, tl)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "width"))
+def _ab_program(feats, rl, tp, tl, *, config, width):
+    """Batched forward+backward log-likelihoods (the AddRead mating gate's
+    inputs) as one jitted program; XLA-recursor counterpart of the Pallas
+    branch in _rebuild."""
+    def one(feat, rlen, win, wlen):
+        alpha = quiver_forward(feat, rlen, win, wlen, config, width)
+        beta = quiver_backward(feat, rlen, win, wlen, config, width)
+        return (quiver_loglik(alpha, rlen, wlen),
+                quiver_loglik_backward(beta, wlen))
+
+    return jax.vmap(one)(feats, rl, tp, tl)
+
 
 
 
@@ -91,6 +120,19 @@ class QuiverMultiReadScorer:
             self._wins.append((jnp.asarray(wpad), jnp.int32(len(win))))
             wins_np.append(wpad)
             wlens.append(len(win))
+        # read axis pads to pow2 (shared contract for both fill backends)
+        # so the per-ZMW pass count doesn't mint a compiled shape each
+        R = self.n_reads
+        Rp = _next_pow2(max(R, 1), 4)
+        pad_r = ((0, Rp - R), (0, 0))
+        feats = self._stacked_feats()
+        feats = QuiverFeatureArrays(*(jnp.pad(t, pad_r) for t in feats))
+        rl = jnp.asarray(np.pad(self._rlens, (0, Rp - R),
+                                constant_values=2))
+        tp = jnp.asarray(np.pad(np.stack(wins_np), pad_r,
+                                constant_values=4))
+        tl = jnp.asarray(np.pad(np.asarray(wlens, np.int32),
+                                (0, Rp - R), constant_values=2))
         if fills_use_pallas():
             # one batched Pallas launch over the read axis (the device
             # analogue of the reference's per-read SSE recursor,
@@ -99,32 +141,23 @@ class QuiverMultiReadScorer:
                 pallas_quiver_backward_batch, pallas_quiver_forward_batch,
                 quiver_loglik_batch)
 
-            feats = self._stacked_feats()
-            rl = jnp.asarray(self._rlens)
-            tp = jnp.asarray(np.stack(wins_np))
-            tl = jnp.asarray(wlens, jnp.int32)
             alpha = pallas_quiver_forward_batch(feats, rl, tp, tl,
                                                 self.config, self._W)
             beta = pallas_quiver_backward_batch(feats, rl, tp, tl,
                                                 self.config, self._W)
-            ll_a = np.asarray(quiver_loglik_batch(alpha, rl, tl), np.float64)
+            ll_a = np.asarray(quiver_loglik_batch(alpha, rl, tl),
+                              np.float64)[:R]
             jcols = np.arange(beta.log_scales.shape[1])[None, :]
-            ll_b = np.log(np.maximum(np.asarray(beta.vals[:, 0, 0]), 1e-30)) \
-                + np.where(jcols <= np.asarray(tl)[:, None],
-                           np.asarray(beta.log_scales), 0.0).sum(axis=1)
+            ll_b = (np.log(np.maximum(np.asarray(beta.vals[:, 0, 0]), 1e-30))
+                    + np.where(jcols <= np.asarray(tl)[:, None],
+                               np.asarray(beta.log_scales), 0.0).sum(axis=1)
+                    )[:R]
         else:
-            lls_a, lls_b = [], []
-            for r in range(self.n_reads):
-                wpad, wlen = self._wins[r]
-                alpha = quiver_forward(self._dev_feats[r], self._rlens[r],
-                                       wpad, wlen, self.config, self._W)
-                beta = quiver_backward(self._dev_feats[r], self._rlens[r],
-                                       wpad, wlen, self.config, self._W)
-                lls_a.append(float(quiver_loglik(alpha, self._rlens[r],
-                                                 wlens[r])))
-                lls_b.append(float(quiver_loglik_backward(beta, wlens[r])))
-            ll_a = np.asarray(lls_a)
-            ll_b = np.asarray(lls_b)
+            # XLA-recursor path: one jitted batched program
+            lls_a, lls_b = _ab_program(feats, rl, tp, tl,
+                                       config=self.config, width=self._W)
+            ll_a = np.asarray(lls_a, np.float64)[:R]
+            ll_b = np.asarray(lls_b, np.float64)[:R]
         self.baselines = ll_a
         denom = np.where(ll_b == 0, 1.0, ll_b)
         mated = (np.abs(1.0 - ll_a / denom) <= _AB_MISMATCH_TOL) & \
@@ -151,20 +184,32 @@ class QuiverMultiReadScorer:
 
     def score_mutations(self, muts: Sequence[mutlib.Mutation]) -> np.ndarray:
         """score(m) = sum over active overlapping reads of
-        (LL(T+m) - LL(T)) via full banded refills of the mutated windows."""
+        (LL(T+m) - LL(T)) via full banded refills of the mutated windows.
+
+        Reads sharing an oriented window geometry (ts, te, strand) share
+        the mutated windows, so windows build once per GROUP and every
+        fill dispatch batches (reads-in-group x mutation-chunk) rows --
+        per-read per-chunk dispatches cost a device round trip each
+        (~0.1-0.25 s over a tunneled link), which made the per-ZMW polish
+        dispatch-bound."""
         if not muts:
             return np.zeros(0)
         L = len(self.tpl)
         jmax = _next_pow2(L + 10, 64)
         scores = np.zeros(len(muts))
-        # per read: build all mutated windows on host, fill in device chunks
+
+        groups: dict[tuple[int, int, int], list[int]] = {}
         for r in range(self.n_reads):
-            if not self.active[r]:
-                continue
-            ts, te = int(self._tstarts[r]), int(self._tends[r])
+            if self.active[r]:
+                key = (int(self._tstarts[r]), int(self._tends[r]),
+                       int(self._strands[r]))
+                groups.setdefault(key, []).append(r)
+
+        for (ts, te, strand), rds in groups.items():
             wins, wlens, idxs = [], [], []
             for k, m in enumerate(muts):
-                overlap = (ts <= m.end) & (m.start <= te) if m.mtype == mutlib.INSERTION \
+                overlap = (ts <= m.end) & (m.start <= te) \
+                    if m.mtype == mutlib.INSERTION \
                     else (ts < m.end) & (m.start < te)
                 if not overlap:
                     continue
@@ -174,7 +219,7 @@ class QuiverMultiReadScorer:
                 delta = len(mt) - L
                 te_m = te + delta if m.start < te else te
                 win = mt[ts:te_m]
-                if self._strands[r] == 1:
+                if strand == 1:
                     win = revcomp(win)
                 wpad = np.full(jmax, 4, np.int8)
                 wpad[:len(win)] = win
@@ -183,41 +228,71 @@ class QuiverMultiReadScorer:
                 idxs.append(k)
             if not wins:
                 continue
-            lls = self._fill_lls(r, np.stack(wins), np.asarray(wlens, np.int32))
-            for k, ll in zip(idxs, lls):
-                scores[k] += ll - self.baselines[r]
+            lls = self._fill_lls_group(rds, np.stack(wins),
+                                       np.asarray(wlens, np.int32))
+            scores[np.asarray(idxs)] += (
+                lls - self.baselines[np.asarray(rds)][:, None]).sum(axis=0)
         return scores
 
-    def _fill_lls(self, r: int, wins: np.ndarray, wlens: np.ndarray) -> np.ndarray:
+    def _fill_lls_group(self, rds: Sequence[int], wins: np.ndarray,
+                        wlens: np.ndarray) -> np.ndarray:
+        """(len(rds), M) absolute LLs of each read in the group against
+        each mutated window: one fill dispatch per fixed-size mutation
+        chunk, with (read x window) riding the batch axis.  Chunks of
+        _MUT_CHUNK (+ one pow2 tail) bound the compiled-shape menu --
+        an unbounded next_pow2(M) menu compiled a fresh fill program per
+        distinct candidate count per round."""
         M = len(wins)
+        if M > _MUT_CHUNK:
+            outs = [self._fill_lls_group(rds, wins[lo: lo + _MUT_CHUNK],
+                                         wlens[lo: lo + _MUT_CHUNK])
+                    for lo in range(0, M, _MUT_CHUNK)]
+            return np.concatenate(outs, axis=1)
+        G = len(rds)
         Mpad = _next_pow2(M, 8)
-        wins_p = np.concatenate([wins, np.full((Mpad - M, wins.shape[1]), 4, np.int8)])
+        wins_p = np.concatenate(
+            [wins, np.full((Mpad - M, wins.shape[1]), 4, np.int8)])
         wlens_p = np.concatenate([wlens, np.full(Mpad - M, 2, np.int32)])
-        feat = self._dev_feats[r]
-        rlen = jnp.int32(self._rlens[r])
+        # batch rows: read-major (read g's windows at rows [g*Mpad, ...)),
+        # then the TOTAL row count pads to pow2 -- G varies per ZMW with
+        # the strand mix, and a (G x Mpad)-keyed shape menu compiled a
+        # fresh fill program per combination
+        rows = G * Mpad
+        rows_p = _next_pow2(rows, 64)
+        tl = jnp.asarray(np.pad(np.tile(wlens_p, G), (0, rows_p - rows),
+                                constant_values=2))
+        tp = jnp.asarray(np.pad(np.tile(wins_p, (G, 1)),
+                                ((0, rows_p - rows), (0, 0)),
+                                constant_values=4))
+        feats = QuiverFeatureArrays(
+            *(jnp.pad(jnp.repeat(
+                jnp.stack([self._dev_feats[r][i] for r in rds]),
+                Mpad, axis=0), ((0, rows_p - rows), (0, 0)))
+              for i in range(len(QuiverFeatureArrays._fields))))
+        rl = jnp.asarray(np.pad(
+            np.repeat(self._rlens[np.asarray(rds)], Mpad),
+            (0, rows_p - rows), constant_values=2))
         if fills_use_pallas():
-            # the mutated windows ride the kernel's read axis (one read
-            # broadcast against M candidate windows)
             from pbccs_tpu.models.quiver.pallas_fill import (
                 pallas_quiver_forward_batch, quiver_loglik_batch)
 
-            feats = QuiverFeatureArrays(
-                *(jnp.broadcast_to(t[None], (Mpad,) + t.shape)
-                  for t in feat))
-            rl = jnp.full(Mpad, rlen, jnp.int32)
-            tl = jnp.asarray(wlens_p)
-            alpha = pallas_quiver_forward_batch(feats, rl,
-                                                jnp.asarray(wins_p), tl,
+            alpha = pallas_quiver_forward_batch(feats, rl, tp, tl,
                                                 self.config, self._W)
             lls = quiver_loglik_batch(alpha, rl, tl)
-            return np.asarray(lls, np.float64)[:M]
+        else:
+            lls = _lls_program(feats, rl, tp, tl, config=self.config,
+                               width=self._W)
+        return np.asarray(lls, np.float64)[:rows].reshape(G, Mpad)[:, :M]
 
-        def one(win, wlen):
-            alpha = quiver_forward(feat, rlen, win, wlen, self.config, self._W)
-            return quiver_loglik(alpha, rlen, wlen)
+    # ------------------------------------------------------------------- QVs
 
-        lls = jax.vmap(one)(jnp.asarray(wins_p), jnp.asarray(wlens_p))
-        return np.asarray(lls, np.float64)[:M]
+    def consensus_qvs(self) -> np.ndarray:
+        """Per-position QVs via the generic single-mutation sweep
+        (models.arrow.refine.consensus_qvs; reference ConsensusQVs is
+        templated over both scorer families, Consensus-inl.hpp:277-297)."""
+        from pbccs_tpu.models.arrow.refine import consensus_qvs
+
+        return consensus_qvs(self)
 
     # --------------------------------------------------------------- mutation
 

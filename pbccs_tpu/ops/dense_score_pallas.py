@@ -93,6 +93,24 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def whole_row_mode(jmax: int) -> bool:
+    """Whether the kernel runs in whole-row mode at this bucket (each ref
+    holds a read's full padded row in VMEM) vs streamed halo'd blocks.
+    One source of truth for the kernel and observability reporting."""
+    jm_pad = -(-jmax // _PB) * _PB
+    return jm_pad <= 1024
+
+
+def cell_vmem_bytes(jmax: int, width: int) -> int:
+    """Static per-grid-cell VMEM footprint estimate of the kernel's input
+    refs (f32 lanes: 4 W-wide fills/reads + offsets/scales/template (3+4+9
+    lanes) + the 72-lane patch grid)."""
+    jm_pad = -(-jmax // _PB) * _PB
+    rows = (jm_pad // _PB + 1) * _PB if whole_row_mode(jmax) \
+        else _PB + _HALO
+    return rows * (4 * width + 3 + 4 + 72 + 9) * 4
+
+
 # --------------------------------------------------------------------------
 # XLA precompute: window-frame patch grids (static shifts, no row selects)
 # --------------------------------------------------------------------------
@@ -200,7 +218,8 @@ def _hs_scan(b, c, W: int):
 
 def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
                   apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
-                  i_ref, live_ref, out_ref, *, W: int):
+                  i_ref, live_ref, out_ref, *, W: int,
+                  whole_row: bool = False):
     """Score all 9 slots of ONE (read, position-block) grid cell.
 
     Each position-indexed ref is a (_PB + _HALO, n) halo'd block of the
@@ -215,7 +234,9 @@ def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     live_ref gates the whole cell: rounds > 0 of the refinement loop
     restrict candidates to nearby windows, so most (read, block) cells
     have no valid slot and skip all compute (their scores are masked
-    downstream; zeros written here are never read)."""
+    downstream; zeros written here are never read).  Its value is the
+    1-based block index (0 = dead): pl.program_id has no CPU-interpret
+    lowering, so the whole_row base offset rides in through the input."""
     @pl.when(live_ref[0, 0, 0] == 0)
     def _dead():
         out_ref[...] = jnp.zeros_like(out_ref)
@@ -224,15 +245,22 @@ def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     def _live():
         _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref,
                            off_ref, apre_ref, bsuf_ref, wtpl_ref, wtr_ref,
-                           pt_ref, i_ref, out_ref, W=W)
+                           pt_ref, i_ref, out_ref, W=W,
+                           base_off=((live_ref[0, 0, 0] - 1) * _PB
+                                     if whole_row else 0))
 
 
 def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
                        apre_ref, bsuf_ref, wtpl_ref, wtr_ref, pt_ref,
-                       i_ref, out_ref, *, W: int):
+                       i_ref, out_ref, *, W: int, base_off=0):
     hit = 1.0 - MISMATCH_PROBABILITY
     miss = MISMATCH_PROBABILITY / 3.0
     I = i_ref[...]  # (1, 1) int32, broadcasts against (PB, W)
+    # base_off: 0 in halo'd-block mode (each ref is this block's halo'd
+    # view); b*_PB in whole_row mode, where each ref holds the read's
+    # ENTIRE padded row (VMEM-resident; Pallas skips the re-fetch across
+    # the b axis since the index map repeats) and the halo'd per-block
+    # views never materialize in HBM.
 
     def ext_col(prev, d, o_col, rbase, cur_b, next_b, prev_tr, cur_tr):
         """One interior ExtendAlpha column over (_PB, W); mirrors
@@ -266,7 +294,7 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         return jnp.log(jnp.maximum(v, _TINY)) + apre_s[:, 0] + bsuf_b[:, 0]
 
     def at(ref, off):
-        return ref[pl.dslice(_OFF0 + off, _PB)]
+        return ref[pl.dslice(base_off + _OFF0 + off, _PB)]
 
     # shared position-aligned slices
     a_m1, a_m2 = at(alpha_ref, -1), at(alpha_ref, -2)
@@ -288,10 +316,12 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     # ext0 once per base and branch only on the second column, saving
     # 4 of the 18 ext_col evaluations per position block.
     for b in range(4):
-        t0 = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice((b * 2 + 0) * 4, 4)]
-        t1s = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice((b * 2 + 1) * 4, 4)]
-        t1i = pt_ref[pl.dslice(_OFF0, _PB),
-                     pl.dslice((8 + b * 2 + 1) * 4, 4)]
+        t0 = pt_ref[pl.dslice(base_off + _OFF0, _PB),
+                     pl.dslice((b * 2 + 0) * 4, 4)]
+        t1s = pt_ref[pl.dslice(base_off + _OFF0, _PB),
+                      pl.dslice((b * 2 + 1) * 4, 4)]
+        t1i = pt_ref[pl.dslice(base_off + _OFF0, _PB),
+                      pl.dslice((8 + b * 2 + 1) * 4, 4)]
         nb = jnp.float32(b)
         ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
         ext1s = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1s)
@@ -301,7 +331,7 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         outs[4 + b] = link(ext1i, o_p1, rn_p1, t1i, w_0, b_p1,
                            jnp.zeros_like(o_p1), -1, ap_0, bs_p1)
     # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
-    t0 = pt_ref[pl.dslice(_OFF0, _PB), pl.dslice(16 * 4, 4)]
+    t0 = pt_ref[pl.dslice(base_off + _OFF0, _PB), pl.dslice(16 * 4, 4)]
     ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
                    wt_m3, wt_m2)
     ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
@@ -365,8 +395,17 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
         ptrans = jax.vmap(dense_patch_grids)(
             win_tpl.astype(jnp.int32), win_trans, tables, wlens)
 
+    # Whole-row mode for templates that fit VMEM: every ref holds the
+    # read's full padded row and the kernel slices block b itself --
+    # Pallas skips re-fetching across the b axis (the index map repeats),
+    # so the ~1.3x halo'd per-block views never materialize in HBM (they
+    # were ~13% of device time).  Long templates keep the streamed halo'd
+    # blocks (constant VMEM in Jmax).
+    whole_row = whole_row_mode(Jm)
+
     def prep(x):
-        return _halo_blocks(_pad_pos(x, jm_pad), jm_pad)
+        padded = _pad_pos(x, jm_pad)
+        return padded if whole_row else _halo_blocks(padded, jm_pad)
 
     alpha_p = prep(alpha.vals)
     beta_p = prep(beta.vals)
@@ -381,16 +420,25 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     i_in = rlens[:, None, None].astype(jnp.int32)
 
     NB = jm_pad // _PB
-    # trailing (1, 1) dims so the (1, 1) block equals the array's last two
-    # dims (the TPU BlockSpec divisibility rule)
+    # live carries the 1-BASED block index (0 = dead cell): the kernel
+    # derives its whole_row base offset from it.  Trailing (1, 1) dims so
+    # the (1, 1) block equals the array's last two dims (the TPU
+    # BlockSpec divisibility rule).
+    bidx1 = jnp.arange(1, NB + 1, dtype=jnp.int32)[None, :]
     if live is None:
-        live_in = jnp.ones((R, NB, 1, 1), jnp.int32)
+        live_in = jnp.broadcast_to(bidx1, (R, NB))[:, :, None, None]
     else:
-        live_in = live.astype(jnp.int32)[:, :, None, None]
+        live_in = jnp.where(live, bidx1, 0).astype(
+            jnp.int32)[:, :, None, None]
     PBH = _PB + _HALO
-    kernel = functools.partial(_dense_kernel, W=W)
-    blk = lambda n: pl.BlockSpec((None, None, PBH, n),
-                                 lambda r, b: (r, b, 0, 0))
+    kernel = functools.partial(_dense_kernel, W=W, whole_row=whole_row)
+    total = (NB + 1) * _PB
+    if whole_row:
+        blk = lambda n: pl.BlockSpec((None, total, n),
+                                     lambda r, b: (r, 0, 0))
+    else:
+        blk = lambda n: pl.BlockSpec((None, None, PBH, n),
+                                     lambda r, b: (r, b, 0, 0))
     out = pl.pallas_call(
         kernel,
         grid=(R, NB),
@@ -466,11 +514,14 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
 
     # per-slot virtual template bases/trans at static absolute window
     # indices (p, k, shift all static per slot; patch overrides at
-    # p-1 / p; index shift beyond p)
+    # p-1 / p; index shift beyond p).  Deliberately per-slot static
+    # SLICES stacked in a Python loop: the "vectorized" static-fancy-index
+    # form lowers to TPU scalar-core gathers and measured ~6% slower
+    # end to end.
     def vB(v: int):
         cols = []
         for m in range(M):
-            p, k = int(_Q27[m]), int(_K27[m])
+            p = int(_Q27[m])
             if v == p - 1:
                 cols.append(tplf[max(p - 1, 0)])
             elif v == p:
@@ -579,12 +630,13 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
     apre_s = apre4[np.clip(t_np - 1, 0, 3)]
 
     # virtual lookups at J-relative static indices: rel r = v - (J-6);
-    # v queried at s-1..s+2 (bases) and s-2..s+1 (trans), p = J-2+q
+    # v queried at s-1..s+2 (bases) and s-2..s+1 (trans), p = J-2+q.
+    # Per-slot static slices (not fancy-index gathers; see vB above).
     def vB_rel(dv: int):
         cols = []
         for m in range(M):
-            q, k = int(_Q27[m]), int(_K27[m])
-            s_rel = 2 + int(t_np[m])                  # s - (J-6) = t + 2
+            q = int(_Q27[m])
+            s_rel = 2 + int(t_np[m])                  # s - (J-6)
             v = s_rel + dv                            # v - (J-6)
             p_rel = 4 + q                             # p - (J-6)
             if v == p_rel - 1:

@@ -322,7 +322,10 @@ def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
     seed = seed_ref[...]
     seedcol = seedcol_ref[...]                              # (RB, 1) int32
     RB, W = seed.shape
-    u = _UNROLL
+    # the Merge variant's extra 15-way shift select per column makes the
+    # 4-column unroll pathologically slow to compile on Mosaic (observed
+    # minutes-to-never at tiny shapes); run it column at a time
+    u = 1 if merge else _UNROLL
 
     def one_col(prev, prev2, sprev, jglob, s, cm, cd, cco, m, s2, cg):
         # band-shift selects: vsm1[k] = prev[k + s - 1], vs[k] = prev[k + s].
@@ -418,11 +421,15 @@ def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool,
     column t holds kernel column nc-1-t.  Passing shifts2+cg engages the
     Merge carry (Quiver recurrence)."""
     R, nc, W = cm.shape
-    rb = min(_RB, R)
+    merge = cg is not None
+    # the Merge carry (Quiver) doubles the live column state (prev2 + its
+    # scale, the 2*MAX_SHIFT select chain): at the full 32-read block its
+    # scoped VMEM tops 16 MB on v5e (observed 18.05M OOM at nc=192,
+    # R=512), so merge fills run half-width read blocks
+    rb = min(_RB // 2 if merge else _RB, R)
     jb = min(_JB, nc)
     assert nc % jb == 0 and R % rb == 0
     njb = nc // jb
-    merge = cg is not None
 
     # kernel layout: (columns, R, W) / (columns, R, 1)
     cm_k = jnp.transpose(cm, (1, 0, 2))

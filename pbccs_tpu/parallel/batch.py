@@ -879,11 +879,19 @@ class BatchPolisher:
 
     def _device_resident_enabled(self) -> bool:
         """One source of truth for the device-resident-path gate (the
-        refinement loop and the QV sweep must agree): single-device runs
-        only, opt-out via PBCCS_DEVICE_REFINE=0/false/off/no."""
-        return self.mesh is None and os.environ.get(
-            "PBCCS_DEVICE_REFINE", "").strip().lower() not in (
-            "0", "false", "off", "no")
+        refinement loop and the QV sweep must agree); opt-out via
+        PBCCS_DEVICE_REFINE=0/false/off/no.  Mesh runs ride the sharded
+        loop (device_refine.run_refine_loop_sharded), which requires the
+        dense scoring path -- without it they fall back to the host
+        loop's sharded per-round programs."""
+        if os.environ.get("PBCCS_DEVICE_REFINE", "").strip().lower() in (
+                "0", "false", "off", "no"):
+            return False
+        if self.mesh is not None:
+            from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
+
+            return dense_score_enabled(self._Jmax)
+        return True
 
     def _loop_state(self, skip=None, it0: int = 0):
         """Assemble the device-resident loop/sweep state from the adopted
@@ -931,11 +939,14 @@ class BatchPolisher:
 
         Returns None when the loop bailed (template outgrew the bucket or
         a tiny-window fallback pair appeared); the caller falls back to
-        the host loop.  Mesh runs use the host loop (the while-loop body
-        is not yet sharding-annotated)."""
+        the host loop.  Mesh runs shard the whole loop over the
+        ('zmw', 'read') mesh (run_refine_loop_sharded): the read-axis
+        score reduction all-reduces over ICI and the host still fetches
+        ONCE at the end."""
+        from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
         from pbccs_tpu.parallel import device_refine as dr
 
-        if self.mesh is not None:
+        if self.mesh is not None and not dense_score_enabled(self._Jmax):
             return None
         opts = opts or RefineOptions()
         budget = opts.max_iterations if budget is None else budget
@@ -950,18 +961,22 @@ class BatchPolisher:
 
         st = self._loop_state(skip, it0=opts.max_iterations - budget)
 
-        from pbccs_tpu.ops.dense_score_pallas import dense_score_enabled
-
         self._qv_cache = None
-        out = dr.run_refine_loop(
-            st, self._reads_dev, self._rlens_dev, self._strands_dev,
-            self._shard(self._host_tables), jnp.asarray(self._real_rows),
+        loop_statics = dict(
             width=self._W, use_pallas=fills_use_pallas(),
             max_iterations=opts.max_iterations,
             separation=opts.mutation_separation,
             neighborhood=opts.mutation_neighborhood,
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
             dense=dense_score_enabled(self._Jmax))
+        loop_args = (st, self._reads_dev, self._rlens_dev,
+                     self._strands_dev, self._shard(self._host_tables),
+                     self._shard(self._real_rows, 1))
+        if self.mesh is not None:
+            out = dr.run_refine_loop_sharded(
+                self.mesh, ZMW_AXIS, READ_AXIS, *loop_args, **loop_statics)
+        else:
+            out = dr.run_refine_loop(*loop_args, **loop_statics)
         # Eager QV sweep on the loop's final state, dispatched back-to-back
         # with the loop program (no host sync between them): consensus_qvs
         # serves from the cached integers, so a refine+QV polish pays ONE
@@ -971,12 +986,16 @@ class BatchPolisher:
         qv_skip[self.n_zmws:] = True
         for z in (skip or ()):
             qv_skip[z] = True
-        qv_i, qv_fb = dr.run_qv_ints(
-            out, self._reads_dev, self._rlens_dev, self._strands_dev,
-            self._shard(self._host_tables), jnp.asarray(self._real_rows),
-            jnp.asarray(qv_skip),
-            chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
-            dense=dense_score_enabled(self._Jmax))
+        qv_statics = dict(chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
+                          dense=dense_score_enabled(self._Jmax))
+        qv_args = (out, self._reads_dev, self._rlens_dev,
+                   self._strands_dev, self._shard(self._host_tables),
+                   self._shard(self._real_rows, 1), self._shard(qv_skip))
+        if self.mesh is not None:
+            qv_i, qv_fb = dr.run_qv_ints_sharded(
+                self.mesh, ZMW_AXIS, READ_AXIS, *qv_args, **qv_statics)
+        else:
+            qv_i, qv_fb = dr.run_qv_ints(*qv_args, **qv_statics)
         # ONE stacked fetch of every outcome plane (each device->host round
         # trip costs ~0.1-0.25 s over the tunneled link; three sequential
         # fetches here were ~0.5 s of pure latency per polish)
@@ -1048,7 +1067,8 @@ class BatchPolisher:
         # round up to the early exit -- max() == each straggler's count
         sub_budget = (budget - max(results[z].iterations
                                    for z in stragglers)) if stragglers else 0
-        if stragglers and sub_budget > 0 and self.n_zmws > len(stragglers):
+        if stragglers and sub_budget > 0 and self.n_zmws > len(stragglers) \
+                and self.mesh is None:
             # the continuation carries the REMAINING round budget (total
             # iterations across parent + sub match the host loop and the
             # reference's single max_iterations bound); the static
@@ -1251,7 +1271,10 @@ class BatchPolisher:
                 for z, t in enumerate(self.tpls[: self.n_zmws])]
         skipped = [z in skip for z in range(self.n_zmws)]
         scores = None
-        if self._device_resident_enabled():
+        if self._device_resident_enabled() and self.mesh is None:
+            # mesh runs serve QVs from the refine-time cache (run_qv_ints
+            # sharded); a cache miss falls through to the chunked sharded
+            # scoring path rather than the unsharded grid program
             scores = self._qv_scores_device(skip, arrs)
         if scores is None:
             scores = self.score_mutation_arrays(arrs)
@@ -1305,6 +1328,55 @@ class BatchPolisher:
             # enumeration order (run_qv_grid packing contract)
             out.append(stacked[z, : arrs[z].size])
         return out
+
+    # -------------------------------------------------------------- banding
+
+    def banding_report(self) -> dict:
+        """Banding / matrix-usage introspection (the TPU analogue of the
+        reference's AllocatedMatrixEntries / UsedMatrixEntries /
+        NumFlipFlops counters, Arrow/MultiReadMutationScorer.hpp:139-144):
+        band occupancy of the current alpha fills, mating-gate outcomes,
+        and the static VMEM footprint of the dense kernel's grid cell.
+        One device fetch; intended for logs and the bench artifact, and
+        for justifying W-per-length-bucket schedules."""
+        from pbccs_tpu.ops.dense_score_pallas import (cell_vmem_bytes,
+                                                      whole_row_mode)
+
+        W = self._W
+        nc = int(self.alpha.vals.shape[2])
+        # occupancy: fraction of band lanes holding live probability mass
+        # per in-window column, averaged over real active reads
+        live_col = (jnp.arange(nc)[None, None, :]
+                    <= self.wlens[:, :, None])
+        nz = jnp.sum((self.alpha.vals > 0) & live_col[:, :, :, None],
+                     axis=(2, 3))
+        denom = jnp.maximum(jnp.sum(live_col, axis=2) * W, 1)
+        occ = nz / denom
+        act = self._active_dev
+        occ_mean = jnp.sum(jnp.where(act, occ, 0.0)) / jnp.maximum(
+            jnp.sum(act), 1)
+        occ_max = jnp.max(jnp.where(act, occ, 0.0))
+        vals = device_fetch(jnp.stack([occ_mean, occ_max]), np.float64)
+        self._ensure_stats()
+        statuses = self._stats_host["statuses"]
+        real = self._real_rows
+        jm = int(self.win_tpl.shape[2])   # the kernel's actual bucket
+        whole_row = whole_row_mode(jm)
+        vmem_cell = cell_vmem_bytes(jm, W)
+        return {
+            "band_width": W,
+            "jmax_bucket": self._Jmax,
+            "imax_bucket": self._Imax,
+            "band_occupancy_mean": round(float(vals[0]), 4),
+            "band_occupancy_max": round(float(vals[1]), 4),
+            "reads_total": int(real.sum()),
+            "mating_failures": int(((statuses == ADD_ALPHABETAMISMATCH)
+                                    & real).sum()),
+            "zscore_drops": int(((statuses == ADD_POOR_ZSCORE)
+                                 & real).sum()),
+            "dense_kernel_mode": "whole_row" if whole_row else "halo",
+            "dense_kernel_vmem_per_cell_bytes": int(vmem_cell),
+        }
 
     def global_zscores(self) -> np.ndarray:
         """(Z,) z-score of the summed log-likelihood per ZMW.

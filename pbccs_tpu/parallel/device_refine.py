@@ -389,6 +389,11 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     grid_w = dense_interior_scores_batch(
         f_reads, f_rlens, f_wt, f_wtr, f_wl, tables, alpha_f, beta_f,
         f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB))
+
+    # edge slots always compute (not gated behind a cond): the edge
+    # program has no data dependence on the kernel output, so XLA
+    # overlaps the two -- a measured win over skipping edges in the
+    # rounds that don't need them
     e6 = edge_window_scores_batch(f_reads, f_rlens, f_wt, f_wtr, f_wl,
                                   alpha_f, beta_f, f_apre, f_bsuf,
                                   ptrans, W)
@@ -406,10 +411,17 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
 
 def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
                     real_rows, start, end, mtype, base, valid, *,
-                    chunk: int, min_fast_edge: int, dense: bool = False):
+                    chunk: int, min_fast_edge: int, dense: bool = False,
+                    read_axis: str | None = None):
     """(Z, M) totals over all candidate slots; also returns the
-    tiny-window fallback flag.  Shared by the refinement loop's per-round
-    scoring and the one-dispatch QV sweep (run_qv_grid).
+    tiny-window fallback flag (LOCAL under shard_map -- the caller makes
+    it global).  Shared by the refinement loop's per-round scoring and
+    the one-dispatch QV sweep (run_qv_grid).
+
+    `read_axis` names the mesh axis the read dimension is sharded over
+    when running inside jax.shard_map: each device reduces its local
+    reads and the final (Z, M) totals all-reduce over that axis (XLA
+    lowers the psum onto ICI).  Only the dense path supports it.
 
     With dense=True the interior scores come from the Pallas dense-grid
     kernel (_score_slot_grid_dense, the TPU path).  Otherwise candidates
@@ -419,9 +431,14 @@ def score_slot_grid(st: "RefineLoopState", reads, rlens, strands, table,
     compacts into the leading chunk(s) and the all-invalid tail chunks
     short-circuit.  Scores scatter back to slot-grid layout."""
     if dense:
-        return _score_slot_grid_dense(st, reads, rlens, strands, table,
-                                      real_rows, start, end, mtype, base,
-                                      valid, min_fast_edge=min_fast_edge)
+        out, fb = _score_slot_grid_dense(st, reads, rlens, strands, table,
+                                         real_rows, start, end, mtype,
+                                         base, valid,
+                                         min_fast_edge=min_fast_edge)
+        if read_axis is not None:
+            out = lax.psum(out, read_axis)
+        return out, fb
+    assert read_axis is None, "mesh scoring requires the dense path"
     from pbccs_tpu.parallel import batch as batchmod
 
     Z = reads.shape[0]
@@ -551,10 +568,11 @@ def qv_from_slot_grid(totals: jax.Array, valid: jax.Array) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "min_fast_edge",
-                                             "dense"))
+                                             "dense", "axis"))
 def run_qv_ints(state: "RefineLoopState", reads, rlens, strands, table,
                 real_rows, skip_mask, *, chunk: int, min_fast_edge: int,
-                dense: bool = False):
+                dense: bool = False,
+                axis: tuple[str, str] | None = None):
     """One-dispatch QV sweep reduced to per-position integer QVs on
     device: (Z, Jmax) int32 + the tiny-window fallback flag.
 
@@ -573,7 +591,10 @@ def run_qv_ints(state: "RefineLoopState", reads, rlens, strands, table,
     totals, fb = score_slot_grid(
         state, reads, rlens, strands, table, real_rows,
         start, end, mtype, base, valid,
-        chunk=chunk, min_fast_edge=min_fast_edge, dense=dense)
+        chunk=chunk, min_fast_edge=min_fast_edge, dense=dense,
+        read_axis=axis[1] if axis else None)
+    if axis is not None:
+        fb = lax.psum(fb.astype(jnp.int32), axis) > 0
     return qv_from_slot_grid(totals, valid), fb
 
 
@@ -611,12 +632,13 @@ def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
 
 @functools.partial(jax.jit, static_argnames=(
     "width", "use_pallas", "max_iterations", "separation", "neighborhood",
-    "chunk", "min_fast_edge", "dense"))
+    "chunk", "min_fast_edge", "dense", "axis"))
 def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
                     real_rows, *, width: int, use_pallas: bool,
                     max_iterations: int, separation: int,
                     neighborhood: int, chunk: int, min_fast_edge: int,
-                    dense: bool = False):
+                    dense: bool = False,
+                    axis: tuple[str, str] | None = None):
     """The jitted device refinement loop: up to max_iterations rounds of
     enumerate -> score -> select -> splice -> rebuild entirely on device
     (lax.while_loop with early exit), so the host fetches once.  A
@@ -628,7 +650,15 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
     documented deviations: candidate ORDER in rounds > 0 is position-major
     rather than the host's center-major (ties across distinct mutations
     resolve differently -- same candidate set), and cycle detection uses a
-    48-deep rolling-hash ring rather than an unbounded exact set."""
+    48-deep rolling-hash ring rather than an unbounded exact set.
+
+    `axis` = (zmw_axis, read_axis) mesh axis names when the loop body runs
+    inside jax.shard_map (see run_refine_loop_sharded): score totals
+    all-reduce over the read axis, and the loop condition / overflow flag
+    reduce over the WHOLE mesh so every device runs the same number of
+    iterations (divergent conds would deadlock the in-body collectives).
+    The straggler early exit is disabled under a mesh -- the continuation
+    sub-batch is a host-side construct that would break the sharding."""
     from pbccs_tpu.models.arrow.params import (revcomp_padded,
                                                template_transition_params)
     from pbccs_tpu.models.arrow.scorer import (fill_alpha_beta_batch_zr,
@@ -662,7 +692,8 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         return score_slot_grid(st, reads, rlens, strands, table, real_rows,
                                start, end, mtype, base, valid,
                                chunk=chunk, min_fast_edge=min_fast_edge,
-                               dense=dense)
+                               dense=dense,
+                               read_axis=axis[1] if axis else None)
 
     def body(st: RefineLoopState) -> RefineLoopState:
         jmax = st.tpl.shape[1]
@@ -736,8 +767,13 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         tstarts = jnp.where(apply_mask[:, None], ts_new, st.tstarts)
         tends = jnp.where(apply_mask[:, None], te_new, st.tends)
 
-        overflow = st.overflow | fb_any | \
+        ov_local = fb_any | \
             (jnp.where(apply_mask, new_tlen, 0) + 2 > jmax).any()
+        if axis is not None:
+            # global any: every device must agree on the bail-out (a
+            # device continuing alone would hang on the body collectives)
+            ov_local = lax.psum(ov_local.astype(jnp.int32), axis) > 0
+        overflow = st.overflow | ov_local
 
         # 6. rebuild fills against the updated templates (skipped entirely
         # when no ZMW applied anything this round -- the final round of a
@@ -775,15 +811,94 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
     # (e.g. one cycling toward the 40-round budget) the loop returns and
     # the caller finishes them in a compact small-Z sub-batch instead of
     # paying Z-wide rounds (batch.BatchPolisher.refine).  Z <= 32 has no
-    # early exit (threshold 0).
-    straggler_exit = reads.shape[0] // 32
+    # early exit (threshold 0); mesh runs have none (the continuation is a
+    # host-side construct) and count live ZMWs across all zmw shards.
+    straggler_exit = 0 if axis is not None else reads.shape[0] // 32
 
     def cond(st: RefineLoopState):
+        live = (~st.done).sum()
+        if axis is not None:
+            live = lax.psum(live, axis[0])
         return ((st.it < max_iterations)
-                & ((~st.done).sum() > straggler_exit)
+                & (live > straggler_exit)
                 & ~st.overflow)
 
     return lax.while_loop(cond, body, state)
+
+
+def _state_specs(zmw: str, read: str) -> "RefineLoopState":
+    """PartitionSpec pytree of RefineLoopState under a (zmw, read) mesh:
+    per-ZMW planes shard on the zmw axis, per-(ZMW, read) planes on both,
+    scalars replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    z, zr, rep = P(zmw), P(zmw, read), P()
+    bm = BandedMatrix(zr, zr, zr)
+    return RefineLoopState(
+        tpl=z, tlens=z, tstarts=zr, tends=zr,
+        win_tpl=zr, win_trans=zr, wlens=zr,
+        alpha=bm, beta=bm, a_prefix=zr, b_suffix=zr,
+        baselines=zr, trans_f=z, tpl_r=z, trans_r=z, active=zr,
+        it=rep, done=z, converged=z, iterations=z, n_tested=z,
+        n_applied=z, allowed=z, history=z, hist_n=z, overflow=rep)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_loop_fn(mesh, zmw_axis: str, read_axis: str,
+                     statics: tuple):
+    """Memoized jitted shard_map wrapper for run_refine_loop: building a
+    fresh jit(shard_map(partial(...))) per call would defeat the jit
+    trace cache and re-trace the whole loop every polish."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = _state_specs(zmw_axis, read_axis)
+    zr, z = P(zmw_axis, read_axis), P(zmw_axis)
+    f = functools.partial(run_refine_loop.__wrapped__,
+                          axis=(zmw_axis, read_axis), **dict(statics))
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(specs, zr, zr, zr, z, zr),
+        out_specs=specs, check_vma=False))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_qv_fn(mesh, zmw_axis: str, read_axis: str, statics: tuple):
+    from jax.sharding import PartitionSpec as P
+
+    specs = _state_specs(zmw_axis, read_axis)
+    zr, z = P(zmw_axis, read_axis), P(zmw_axis)
+    f = functools.partial(run_qv_ints.__wrapped__,
+                          axis=(zmw_axis, read_axis), **dict(statics))
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(specs, zr, zr, zr, z, zr, z),
+        out_specs=(z, P()), check_vma=False))
+
+
+def run_refine_loop_sharded(mesh, zmw_axis: str, read_axis: str,
+                            state: "RefineLoopState", reads, rlens,
+                            strands, table, real_rows, **statics):
+    """run_refine_loop under jax.shard_map over a (zmw, read) mesh: each
+    device owns a (Z/nz, R/nr) block and the WHOLE while_loop runs
+    device-resident per shard, with the score all-reduce over the read
+    axis and globally-agreed loop condition (the DP-over-ZMW-shards
+    design of SURVEY.md section 2.3, with the read axis riding ICI).
+    check_vma=False: pallas_call outputs carry no varying-mesh-axes
+    metadata (same caveat as scorer.fill_alpha_beta_batch_zr)."""
+    fn = _sharded_loop_fn(mesh, zmw_axis, read_axis,
+                          tuple(sorted(statics.items())))
+    return fn(state, reads, rlens, strands, table, real_rows)
+
+
+def run_qv_ints_sharded(mesh, zmw_axis: str, read_axis: str,
+                        state: "RefineLoopState", reads, rlens, strands,
+                        table, real_rows, skip_mask, **statics):
+    """run_qv_ints under the same shard_map contract as
+    run_refine_loop_sharded; returns ((Z, Jmax) int32 QVs sharded on the
+    zmw axis, global fallback flag)."""
+    fn = _sharded_qv_fn(mesh, zmw_axis, read_axis,
+                        tuple(sorted(statics.items())))
+    return fn(state, reads, rlens, strands, table, real_rows, skip_mask)
 
 
 def nearby_allowed(fav_start: jax.Array, fav_end: jax.Array,

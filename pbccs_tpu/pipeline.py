@@ -48,6 +48,11 @@ class ConsensusSettings:
     min_zscore: float = -5.0
     max_drop_fraction: float = 0.34
     refine: RefineOptions = dataclasses.field(default_factory=RefineOptions)
+    # polish model family: "arrow" (the ccs default) or "quiver" (the
+    # QV-feature model; reference ConsensusCore carries both behind one
+    # templated refine/QV implementation, Consensus.hpp:64-79).  Subreads
+    # without QV tracks polish with flat default tracks.
+    model: str = "arrow"
 
 
 @dataclasses.dataclass
@@ -343,11 +348,52 @@ def _finish_zmw(prep: PreparedZmw, settings: ConsensusSettings,
         elapsed_ms=elapsed_ms)
 
 
+def polish_prepared_quiver(prep: PreparedZmw, settings: ConsensusSettings
+                           ) -> tuple[Failure, ConsensusResult | None]:
+    """Quiver-model polish of a prepared ZMW: same stage structure as the
+    Arrow path (gates -> refine -> QVs -> finish), driven through the
+    generic refine/QV implementations over QuiverMultiReadScorer
+    (reference Quiver/MultiReadMutationScorer.cpp behind the templated
+    RefineConsensus/ConsensusQVs, Consensus-inl.hpp:160-297).  Subreads
+    carry no QV tracks here, so the features use flat default tracks
+    (param-only move scores); Quiver has no closed-form Z-score moments
+    (an Arrow-specific construct, Arrow/Expectations.hpp), so z-score
+    fields report NaN and the z-score gate is vacuous."""
+    from pbccs_tpu.models.arrow.refine import consensus_qvs
+    from pbccs_tpu.models.quiver.features import flat_default_features
+    from pbccs_tpu.models.quiver.scorer import QuiverMultiReadScorer
+
+    t0 = time.monotonic()
+    scorer = QuiverMultiReadScorer(
+        prep.css,
+        [flat_default_features(m.seq) for m in prep.mapped],
+        [m.strand for m in prep.mapped],
+        [m.tpl_start for m in prep.mapped],
+        [m.tpl_end for m in prep.mapped])
+
+    failure, status_counts, n_passes = _read_gates(prep, scorer.statuses,
+                                                   settings)
+    if failure is not None:
+        return failure, None
+
+    refine = refine_consensus(scorer, settings.refine)
+    if not refine.converged:
+        return Failure.NON_CONVERGENT, None
+    qvs = consensus_qvs(scorer)
+    elapsed_ms = prep.prep_ms + (time.monotonic() - t0) * 1e3
+    nan_zs = np.full(scorer.n_reads, np.nan)
+    return _finish_zmw(prep, settings, scorer.tpl, qvs, refine,
+                       nan_zs, float("nan"), status_counts, n_passes,
+                       elapsed_ms)
+
+
 def polish_prepared(prep: PreparedZmw, settings: ConsensusSettings
                     ) -> tuple[Failure, ConsensusResult | None]:
     """The serial polish half of the per-ZMW pipeline, given an already
     prepared (filtered + drafted + mapped) ZMW.  The serial scorer owns the
     wider-band AddRead retry."""
+    if settings.model == "quiver":
+        return polish_prepared_quiver(prep, settings)
     t0 = time.monotonic()
     scorer = ArrowMultiReadScorer(
         prep.css, prep.chunk.snr,
@@ -396,7 +442,9 @@ def process_chunks(chunks: Sequence[Chunk],
     to the serial per-ZMW path to preserve fault isolation."""
     settings = settings or ConsensusSettings()
     tally = ResultTally()
-    if not batch_polish:
+    # the lockstep BatchPolisher is the Arrow device path; Quiver polishes
+    # through the per-ZMW pipeline (its scorer batches fills internally)
+    if not batch_polish or settings.model == "quiver":
         for chunk in chunks:
             try:
                 failure, result = process_chunk(chunk, settings)
@@ -484,6 +532,15 @@ def process_chunks(chunks: Sequence[Chunk],
                         wide_pick[z] = i
                         gate_info[z] = _read_gates(
                             preps[z], wide.statuses[i], settings)
+            # banding observability: retry outcomes per batch (the
+            # reference's NumFlipFlops analogue at batch granularity)
+            from pbccs_tpu.runtime.logging import Logger
+
+            Logger.default().debug(
+                f"band retry: {len(reband)} ZMW(s) had mating failures at "
+                f"W={polisher.config.banding.band_width}; "
+                f"{len(wide_pick)} adopted the 2x band, "
+                f"{len(reband) - len(wide_pick)} reverted")
         # gate-failed ZMWs are excluded from refinement/QV (the serial path
         # returns before polishing them); their batch slots stay idle
         gate_failed = {z for z, g in enumerate(gate_info) if g[0] is not None}
