@@ -308,16 +308,44 @@ SWEEP_CONFIGS = [
 
 def bench_sweep(ref_cfgs: dict) -> list[dict]:
     """Run every sweep config; returns per-config result dicts with
-    vs_reference_cpp where BASELINE_LOCAL.json records the C++ number."""
+    vs_reference_cpp where BASELINE_LOCAL.json records the C++ number.
+
+    Each config runs under a watchdog (BENCH_CONFIG_TIMEOUT, default
+    900 s): this environment's remote TPU compile helper has been
+    observed to take unbounded time on very large programs (the 15 kb
+    bucket; docs/PROFILE_r04.md), and one wedged compile must not stall
+    the whole artifact.  A timed-out config records an error entry; its
+    worker thread is abandoned (daemon) -- the compile it blocks on does
+    not hold the device, so later configs proceed."""
+    import threading
+
+    timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 900))
     out = []
     for name, z, L, passes, nc, batch, reps in SWEEP_CONFIGS:
         print(f"bench sweep: {name} (Z={z} L={L} P={passes})",
               file=sys.stderr)
-        try:
-            stats = bench(z, L, passes, nc, batch, repeats=reps)
-        except Exception as e:  # noqa: BLE001 -- record, don't abort the run
-            out.append({"name": name, "error": f"{type(e).__name__}: {e}"})
+        box: dict = {}
+
+        def run_one(box=box, args=(z, L, passes, nc, batch, reps)):
+            try:
+                box["stats"] = bench(*args[:5], repeats=args[5])
+            except Exception as e:  # noqa: BLE001
+                box["err"] = f"{type(e).__name__}: {e}"
+
+        # plain daemon thread, NOT ThreadPoolExecutor: its atexit hook
+        # would join the abandoned worker and hang process exit
+        th = threading.Thread(target=run_one, daemon=True)
+        th.start()
+        th.join(timeout)
+        if th.is_alive():
+            out.append({"name": name,
+                        "error": f"timeout after {timeout:.0f}s "
+                                 "(remote compile; see PROFILE_r04.md)"})
             continue
+        if "err" in box:
+            out.append({"name": name, "error": box["err"]})
+            continue
+        stats = box["stats"]
         entry = {
             "name": name, "n_zmws": z, "tpl_len": L, "n_passes": passes,
             "batch": batch,
@@ -414,7 +442,11 @@ def bench_quiver(n_zmws: int = 4, tpl_len: int = 120,
 
 def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
                    n_passes: str = "8", n_corr: int = 2,
-                   chunk: int = 256) -> dict:
+                   chunk: int = 128) -> dict:
+    # chunk pinned to 128 -- the headline bench's thoroughly-exercised
+    # polisher shape; a chunk-256 shakeout produced zero successes in its
+    # warm pass (unexplained Z=256 CLI-path anomaly, see
+    # docs/PROFILE_r04.md known issues) and minted fresh compiles
     """The 150k-ZMW-cell proxy (BASELINE.json config 5): >=10k simulated
     ZMWs streamed FASTA -> BAM through cli.run's reader -> WorkQueue ->
     batched polish -> writer pipeline.  One small warmup run compiles the
