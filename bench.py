@@ -310,42 +310,50 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
     """Run every sweep config; returns per-config result dicts with
     vs_reference_cpp where BASELINE_LOCAL.json records the C++ number.
 
-    Each config runs under a watchdog (BENCH_CONFIG_TIMEOUT, default
-    900 s): this environment's remote TPU compile helper has been
-    observed to take unbounded time on very large programs (the 15 kb
-    bucket; docs/PROFILE_r04.md), and one wedged compile must not stall
-    the whole artifact.  A timed-out config records an error entry; its
-    worker thread is abandoned (daemon) -- the compile it blocks on does
-    not hold the device, so later configs proceed."""
-    import threading
+    Each config runs in its OWN SUBPROCESS under a hard timeout
+    (BENCH_CONFIG_TIMEOUT, default 900 s): this environment's remote TPU
+    compile helper has been observed to take unbounded time on very
+    large programs (the 15 kb bucket; docs/PROFILE_r04.md), and an
+    abandoned in-process compile thread poisons subsequent device work
+    (a chunk-256 shakeout after a wedged 15 kb compile threw on every
+    ZMW).  Killing the subprocess leaves the parent's backend clean;
+    the axon device accepts concurrent processes, and the persistent
+    compilation cache is shared."""
+    import subprocess
 
     timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 900))
+    repo = os.path.dirname(os.path.abspath(__file__))
     out = []
     for name, z, L, passes, nc, batch, reps in SWEEP_CONFIGS:
         print(f"bench sweep: {name} (Z={z} L={L} P={passes})",
               file=sys.stderr)
-        box: dict = {}
-
-        def run_one(box=box, args=(z, L, passes, nc, batch, reps)):
-            try:
-                box["stats"] = bench(*args[:5], repeats=args[5])
-            except Exception as e:  # noqa: BLE001
-                box["err"] = f"{type(e).__name__}: {e}"
-
-        # plain daemon thread, NOT ThreadPoolExecutor: its atexit hook
-        # would join the abandoned worker and hang process exit
-        th = threading.Thread(target=run_one, daemon=True)
-        th.start()
-        th.join(timeout)
-        if th.is_alive():
+        code = (
+            "import sys, json\n"
+            f"sys.path.insert(0, {repo!r})\n"
+            "from pbccs_tpu.runtime.cache import enable_compilation_cache\n"
+            "enable_compilation_cache()\n"
+            "from bench import bench\n"
+            f"s = bench({z}, {L}, {passes!r}, {nc}, {batch}, "
+            f"repeats={reps})\n"
+            "print('RESULT::' + json.dumps(s))\n")
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
             out.append({"name": name,
                         "error": f"timeout after {timeout:.0f}s "
                                  "(remote compile; see PROFILE_r04.md)"})
             continue
-        if "err" in box:
-            out.append({"name": name, "error": box["err"]})
+        stats = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT::"):
+                stats = json.loads(line[len("RESULT::"):])
+        if stats is None:
+            out.append({"name": name,
+                        "error": f"subprocess rc={proc.returncode}: "
+                                 f"{proc.stderr[-300:]}"})
             continue
-        stats = box["stats"]
         entry = {
             "name": name, "n_zmws": z, "tpl_len": L, "n_passes": passes,
             "batch": batch,
