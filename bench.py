@@ -298,14 +298,16 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
 # comparable statistic and the reference C++ numbers in
 # BASELINE_LOCAL.json["configs"] are measured on identical workloads
 # (native/refbench with the same env knobs).
+# repeats >= 3 where affordable: numpy's median of TWO runs is their
+# mean, so a single compile-hit/link-stall repeat wrecked entries
 SWEEP_CONFIGS = [
-    ("batch512_300bp_8p", 512, 300, "8", 2, 512, 2, {}),
+    ("batch512_300bp_8p", 512, 300, "8", 2, 512, 3, {}),
     # cfg2/cfg4 batch sizes keep the CHILD process's fill/coefficient
     # footprint small: sweep configs run in subprocesses while the parent
     # still holds its own device buffers, and the 2 kb / 30-pass shapes
     # OOMed the shared HBM at larger batches
     ("cfg2_2kb_3-10p", 128, 2000, "3-10", 2, 32, 1, {}),
-    ("cfg4_30px500bp", 64, 500, "30", 2, 64, 2, {}),
+    ("cfg4_30px500bp", 64, 500, "30", 2, 64, 3, {}),
     # 15 kb runs the HOST refinement loop with chunked device scoring:
     # the device-resident loop / dense-kernel programs at this bucket
     # never finish compiling through the remote compile helper
