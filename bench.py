@@ -653,8 +653,20 @@ def main() -> None:
     line["device_wait_fraction"] = stats["device_wait_fraction"]
     if e2e:
         line["ccs_zmws_per_sec"] = round(e2e["ccs_zmws_per_sec"], 4)
+    # The driver captures only the TAIL of stdout, so the last line must be
+    # the compact headline (round 4's inline sweep clipped the headline
+    # fields out of BENCH_r04.json).  The full record — headline + per-run
+    # stats + every sweep config — is committed to BENCH_RESULTS.json.
+    full = {"headline": line, "headline_detail": stats}
+    if e2e:
+        full["e2e"] = e2e
     if configs is not None:
-        line["configs"] = configs
+        full["configs"] = configs
+    results_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_RESULTS.json")
+    with open(results_file, "w") as f:
+        json.dump(full, f, indent=2)
+    print(f"bench: full results written to {results_file}", file=sys.stderr)
     print(json.dumps(line))
 
 
