@@ -15,6 +15,7 @@ device programs with static (R, M, Imax, Jmax, W) bucket shapes.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -25,6 +26,7 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
+    effective_band_width,
     revcomp,
     snr_to_transition_table_host,
     template_transition_params,
@@ -82,8 +84,32 @@ def oriented_window(strand, ts, te, tpl_f, trans_f, tpl_r, trans_r, L):
     return win_tpl, win_trans, wlen
 
 
+def guided_fill_passes(jmax: int) -> int:
+    """How many argmax-guided refill ("flip-flop") passes the fill dispatch
+    runs after the diagonal-band fill at this template bucket.
+
+    At long templates the alignment path's indel random walk drifts
+    ~sqrt(L) rows off the straight diagonal; past ~W/2 the fixed band
+    clips real probability mass -- alpha and beta stay CONSISTENT (same
+    band) so the mating gate passes, but the likelihood surface is wrong
+    and polish accuracy collapses (the round-4 15 kb regression).  Guided
+    refills re-center the band on the observed path (fwdbwd.
+    guided_band_offsets), the TPU analogue of the reference's guide-matrix
+    rebanding + flip-flop (SimpleRecursor.cpp:642-757).  Short templates
+    drift well within W/2 (measured +-16 rows at 2 kb) and skip the cost.
+
+    Env override PBCCS_GUIDED: integer pass count, or 0 to disable."""
+    env = os.environ.get("PBCCS_GUIDED")
+    if env is not None:
+        return max(0, int(env))
+    if jmax <= 2048:
+        return 0
+    return 1 if jmax <= 6144 else 2
+
+
 def fill_alpha_beta_batch(reads, rlens, win_tpl, win_trans, wlens, width: int,
-                          use_pallas: bool | None = None):
+                          use_pallas: bool | None = None, offsets=None,
+                          guided_passes: int = 0):
     """Batched alpha/beta fills + log-likelihoods + scale prefixes.
 
     Dispatches to the Pallas TPU kernel (ops.fwdbwd_pallas) when available,
@@ -93,32 +119,80 @@ def fill_alpha_beta_batch(reads, rlens, win_tpl, win_trans, wlens, width: int,
     `use_pallas` must be resolved by the caller when this runs under jit --
     the dispatch is a trace-time decision, so jitted callers thread it
     through as a static argument (else a stale executable would silently
-    ignore a changed PBCCS_PALLAS)."""
+    ignore a changed PBCCS_PALLAS).
+
+    `offsets` (R, nc) pins the band layout (e.g. carried from a previous
+    round's guided fill); `guided_passes` > 0 additionally re-centers the
+    band on the alpha argmax path and refills that many times (static
+    trace-time count -- see guided_fill_passes)."""
+    from pbccs_tpu.ops.fwdbwd import BandedMatrix, guided_band_offsets
+
+    alpha, ll_a = _fill_alpha(reads, rlens, win_tpl, win_trans, wlens,
+                              width, use_pallas, offsets)
+    for _ in range(guided_passes):
+        g_off = jax.vmap(
+            lambda av, ao, i, jl: guided_band_offsets(av, ao, i, jl, width)
+        )(alpha.vals, alpha.offsets, rlens, wlens)
+        alpha_g, ll_g = _fill_alpha(reads, rlens, win_tpl, win_trans, wlens,
+                                    width, use_pallas, g_off)
+        # keep-better per read: a re-centered band normally recovers the
+        # probability mass the diagonal band clipped, but when the first
+        # fill locked onto a wrong ridge the guided band can LOSE mass --
+        # never trade down (same keep-better-width rule as the host's 2x
+        # band retry, and the reference's flip-flop acceptance test)
+        keep = ll_g >= ll_a
+        alpha = BandedMatrix(
+            jnp.where(keep[:, None, None], alpha_g.vals, alpha.vals),
+            jnp.where(keep[:, None], alpha_g.offsets, alpha.offsets),
+            jnp.where(keep[:, None], alpha_g.log_scales, alpha.log_scales))
+        ll_a = jnp.where(keep, ll_g, ll_a)
+    beta, ll_b = _fill_beta(reads, rlens, win_tpl, win_trans, wlens,
+                            width, use_pallas,
+                            alpha.offsets if guided_passes else offsets)
+    apre = jax.vmap(scale_prefix)(alpha.log_scales)
+    bsuf = jax.vmap(scale_suffix)(beta.log_scales)
+    return alpha, beta, ll_a, ll_b, apre, bsuf
+
+
+def _fill_alpha(reads, rlens, win_tpl, win_trans, wlens, width: int,
+                use_pallas: bool | None, offsets):
     from pbccs_tpu.ops import fwdbwd_pallas as fpal
 
     if use_pallas is None:
         use_pallas = fpal.fills_use_pallas()
     if use_pallas:
         alpha = fpal.pallas_forward_batch(reads, rlens, win_tpl, win_trans,
-                                          wlens, width)
+                                          wlens, width, offsets=offsets)
+        return alpha, fpal.forward_loglik_batch(alpha, rlens, wlens)
+    alpha = jax.vmap(
+        lambda r, i, t, tr, j, o: banded_forward(r, i, t, tr, j, width,
+                                                 offsets=o),
+        in_axes=(0, 0, 0, 0, 0, None if offsets is None else 0),
+    )(reads, rlens, win_tpl, win_trans, wlens, offsets)
+    return alpha, jax.vmap(forward_loglik)(alpha, rlens, wlens)
+
+
+def _fill_beta(reads, rlens, win_tpl, win_trans, wlens, width: int,
+               use_pallas: bool | None, offsets):
+    from pbccs_tpu.ops import fwdbwd_pallas as fpal
+
+    if use_pallas is None:
+        use_pallas = fpal.fills_use_pallas()
+    if use_pallas:
         beta = fpal.pallas_backward_batch(reads, rlens, win_tpl, win_trans,
-                                          wlens, width)
-        ll_a = fpal.forward_loglik_batch(alpha, rlens, wlens)
-        ll_b = fpal.backward_loglik_batch(beta, wlens)
-    else:
-        alpha = jax.vmap(lambda r, i, t, tr, j: banded_forward(r, i, t, tr, j, width))(
-            reads, rlens, win_tpl, win_trans, wlens)
-        beta = jax.vmap(lambda r, i, t, tr, j: banded_backward(r, i, t, tr, j, width))(
-            reads, rlens, win_tpl, win_trans, wlens)
-        ll_a = jax.vmap(forward_loglik)(alpha, rlens, wlens)
-        ll_b = jax.vmap(backward_loglik)(beta, wlens)
-    apre = jax.vmap(scale_prefix)(alpha.log_scales)
-    bsuf = jax.vmap(scale_suffix)(beta.log_scales)
-    return alpha, beta, ll_a, ll_b, apre, bsuf
+                                          wlens, width, offsets=offsets)
+        return beta, fpal.backward_loglik_batch(beta, wlens)
+    beta = jax.vmap(
+        lambda r, i, t, tr, j, o: banded_backward(r, i, t, tr, j, width,
+                                                  offsets=o),
+        in_axes=(0, 0, 0, 0, 0, None if offsets is None else 0),
+    )(reads, rlens, win_tpl, win_trans, wlens, offsets)
+    return beta, jax.vmap(backward_loglik)(beta, wlens)
 
 
 def fill_alpha_beta_batch_zr(reads, rlens, win_tpl, win_trans, wlens,
-                             width: int, use_pallas: bool, mesh=None):
+                             width: int, use_pallas: bool, mesh=None,
+                             guided_passes: int = 0):
     """(Z, R)-leading alpha/beta fills + log-likelihoods + scale prefixes.
 
     Unsharded (mesh=None) this flattens to the (Z*R,) read batch and
@@ -137,7 +211,7 @@ def fill_alpha_beta_batch_zr(reads, rlens, win_tpl, win_trans, wlens,
     if mesh is None or not use_pallas:
         out = fill_alpha_beta_batch(flat(reads), flat(rlens), flat(win_tpl),
                                     flat(win_trans), flat(wlens), width,
-                                    use_pallas)
+                                    use_pallas, guided_passes=guided_passes)
         return jax.tree.map(unflat, out)
 
     from jax.sharding import PartitionSpec
@@ -145,7 +219,8 @@ def fill_alpha_beta_batch_zr(reads, rlens, win_tpl, win_trans, wlens,
 
     def body(r, i, t, tr, j):
         # each device runs the unsharded path on its local (Z/nz, R/nr) block
-        return fill_alpha_beta_batch_zr(r, i, t, tr, j, width, True, None)
+        return fill_alpha_beta_batch_zr(r, i, t, tr, j, width, True, None,
+                                        guided_passes=guided_passes)
 
     spec = PartitionSpec(ZMW_AXIS, READ_AXIS)
     # check_vma=False: pallas_call's out_shapes carry no varying-mesh-axes
@@ -155,17 +230,19 @@ def fill_alpha_beta_batch_zr(reads, rlens, win_tpl, win_trans, wlens,
         reads, rlens, win_tpl, win_trans, wlens)
 
 
-@functools.partial(jax.jit, static_argnames=("width", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas",
+                                             "guided_passes"))
 def _setup_reads(reads, rlens, strands, tstarts, tends,
                  tpl_f, trans_f, tpl_r, trans_r, L, width: int,
-                 use_pallas: bool):
+                 use_pallas: bool, guided_passes: int = 0):
     """Build per-read oriented windows and fill alpha/beta for each read."""
     win_tpl, win_trans, wlens = jax.vmap(
         lambda s, a, b: oriented_window(s, a, b, tpl_f, trans_f,
                                         tpl_r, trans_r, L)
     )(strands, tstarts, tends)
     alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
-        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas)
+        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas,
+        guided_passes=guided_passes)
     return (win_tpl, win_trans, wlens, alpha, beta, ll_a, ll_b, apre, bsuf)
 
 
@@ -311,7 +388,7 @@ class ArrowMultiReadScorer:
         self._R = R
         self._Imax = imax or _next_pow2(max(len(r) for r in read_codes) + 8, 64)
         self._Jmax = jmax or _next_pow2(len(tpl) + 8, 64)
-        self._W = self.config.banding.band_width
+        self._W = effective_band_width(self.config.banding, self._Jmax)
 
         self._reads = np.full((R, self._Imax), 4, np.int8)
         self._rlens = np.zeros(R, np.int32)
@@ -415,7 +492,8 @@ class ArrowMultiReadScorer:
             jnp.asarray(self._strands), jnp.asarray(self._tstarts),
             jnp.asarray(self._tends),
             self.tpl_f, self.trans_f, self.tpl_r, self.trans_r,
-            jnp.int32(L), self._W, fills_use_pallas())
+            jnp.int32(L), self._W, fills_use_pallas(),
+            guided_fill_passes(self._Jmax))
 
         ll_a = np.asarray(ll_a, np.float64)
         ll_b = np.asarray(ll_b, np.float64)

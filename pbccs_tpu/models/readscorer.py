@@ -11,8 +11,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from pbccs_tpu.models.arrow.params import ArrowConfig, encode_bases, \
-    snr_to_transition_table_host, template_transition_params
+from pbccs_tpu.models.arrow.params import ArrowConfig, effective_band_width, \
+    encode_bases, snr_to_transition_table_host, template_transition_params
 from pbccs_tpu.ops.fwdbwd import banded_forward, forward_loglik
 from pbccs_tpu.utils import next_pow2
 
@@ -44,7 +44,7 @@ def score_read(read, template, snr, config: ArrowConfig | None = None) -> float:
                                        jnp.int32(len(tpl_c)))
     alpha = banded_forward(jnp.asarray(rpad), jnp.int32(len(read_c)),
                            jnp.asarray(tpad), trans, jnp.int32(len(tpl_c)),
-                           config.banding.band_width)
+                           effective_band_width(config.banding, jmax))
     return float(forward_loglik(alpha, len(read_c), len(tpl_c)))
 
 

@@ -85,6 +85,68 @@ def band_offsets(read_len, tpl_len, n_cols: int, width: int):
     return jnp.clip(off, 0, hi)
 
 
+#: Maximum band advance per template column representable by the Pallas
+#: fill kernel's shift-variant select (ops/fwdbwd_pallas._MAX_SHIFT).
+#: guided_band_offsets clamps its output slope to this so guided fills
+#: never trip the kernel's overflow drop.
+MAX_BAND_ADVANCE = 7
+
+
+def guided_band_offsets(alpha_vals, alpha_offsets, read_len, tpl_len,
+                        width: int, n_cols: int | None = None,
+                        smooth: int = 8) -> jax.Array:
+    """Re-center the band on the alignment path observed in a prior alpha
+    fill: per-column centers are the band argmax rows (the posterior mode
+    path), smoothed, made monotone, slope-clamped to MAX_BAND_ADVANCE, and
+    pinned to the (0,0)/(I,J) corners.
+
+    This is the TPU re-design of the reference's guide-matrix rebanding +
+    alpha/beta flip-flop (reference ConsensusCore/src/C++/Arrow/
+    SimpleRecursor.cpp:642-757): instead of adaptively re-thresholding the
+    band per column on the host, a fixed-width band is re-laid along the
+    path the previous fill found — a pure array program that runs inside
+    jit.  At long templates (15 kb) the indel random-walk drifts the true
+    path ~sqrt(L) rows off the straight diagonal, past W/2; one or two
+    guided refills recover it (the reference's flip-flop count analogue).
+
+    alpha_vals (ncA, W), alpha_offsets (ncA,): a prior fill's band.
+    Returns (n_cols,) int32 offsets (n_cols defaults to ncA; extra columns
+    repeat the last value so kernel shift/overflow math sees slope 0).
+    """
+    ncA = alpha_vals.shape[0]
+    n_cols = ncA if n_cols is None else n_cols
+    W = width
+    S = MAX_BAND_ADVANCE
+    I = jnp.asarray(read_len, jnp.int32)
+    J = jnp.asarray(tpl_len, jnp.int32)
+    j = jnp.arange(ncA, dtype=jnp.float32)
+
+    c = (alpha_offsets + jnp.argmax(alpha_vals, axis=-1)).astype(jnp.float32)
+    c = jnp.where(j <= J, c, I.astype(jnp.float32))
+    c = jnp.minimum(c, I.astype(jnp.float32))
+    if smooth:
+        # boxcar mean via cumsum (edge-padded)
+        k = smooth
+        cp = jnp.concatenate([jnp.broadcast_to(c[0:1], (k,)), c,
+                              jnp.broadcast_to(c[-1:], (k,))])
+        cs = jnp.cumsum(cp)
+        c = (cs[2 * k:] - jnp.concatenate([jnp.zeros(1), cs[:-2 * k - 1]])) \
+            / (2 * k + 1)
+    c = lax.associative_scan(jnp.maximum, c)                 # monotone
+    # slope <= S: o(j) = min_{k<=j} (c(k) + S*(j-k))
+    o = lax.associative_scan(jnp.minimum, c - S * j) + S * j
+    # left-edge anchor: the pinned start means columns 0/1 must keep rows
+    # 0/1 in band (alpha seed / EDGE_CONDITION); same envelope from (0, 0)
+    o = jnp.minimum(o, 1.0 + S * jnp.maximum(j - 1.0, 0.0))
+    off = jnp.clip(jnp.floor(o).astype(jnp.int32) - W // 2, 0,
+                   jnp.maximum(I + 1 - W, 0))
+    off = lax.associative_scan(jnp.maximum, off)             # monotone again
+    if n_cols > ncA:
+        off = jnp.concatenate([
+            off, jnp.broadcast_to(off[-1:], (n_cols - ncA,))])
+    return off[:n_cols]
+
+
 def _affine_scan(b: jax.Array, c: jax.Array, reverse: bool = False) -> jax.Array:
     """Solve v[k] = b[k] + c[k] * v[k-1] (v[-1] = 0) along the last axis.
 
@@ -108,12 +170,15 @@ def _gather_band(col_vals, col_offset, rows):
 
 
 def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
-                   pr_miscall: float = MISMATCH_PROBABILITY) -> BandedMatrix:
+                   pr_miscall: float = MISMATCH_PROBABILITY,
+                   offsets=None) -> BandedMatrix:
     """Banded forward (alpha) fill.
 
     read: (Imax,) int8 codes (padded); read_len: scalar int32 I.
     tpl:  (Jmax,) int8 codes (padded); tpl_len:  scalar int32 J.
     trans: (Jmax, 4) natural-scale transition probs (padded with zeros).
+    offsets: optional (Jmax+1,) precomputed band offsets (e.g. guided;
+    see guided_band_offsets); default is the diagonal band layout.
 
     Returns BandedMatrix over columns 0..Jmax (column 0 is the pinned seed;
     the final pinned cell (I, J) lives in column J of the band).
@@ -127,7 +192,10 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
 
     I = jnp.asarray(read_len, jnp.int32)
     J = jnp.asarray(tpl_len, jnp.int32)
-    offsets = band_offsets(I, J, Jmax + 1, W)
+    if offsets is None:
+        offsets = band_offsets(I, J, Jmax + 1, W)
+    else:
+        offsets = jnp.asarray(offsets, jnp.int32)[: Jmax + 1]
 
     col0 = jnp.zeros(W, jnp.float32).at[0].set(1.0)  # row 0 only: alpha(0,0)=1
     # offsets[0] is 0 by construction, so col0's band starts at row 0.
@@ -198,7 +266,8 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
 
 
 def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
-                    pr_miscall: float = MISMATCH_PROBABILITY) -> BandedMatrix:
+                    pr_miscall: float = MISMATCH_PROBABILITY,
+                    offsets=None) -> BandedMatrix:
     """Banded backward (beta) fill; mirror of banded_forward.
 
     Parity: SimpleRecursor::FillBeta (SimpleRecursor.cpp:185-296).
@@ -213,7 +282,10 @@ def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
 
     I = jnp.asarray(read_len, jnp.int32)
     J = jnp.asarray(tpl_len, jnp.int32)
-    offsets = band_offsets(I, J, Jmax + 1, W)
+    if offsets is None:
+        offsets = band_offsets(I, J, Jmax + 1, W)
+    else:
+        offsets = jnp.asarray(offsets, jnp.int32)[: Jmax + 1]
 
     read_i32 = read.astype(jnp.int32)
     tpl_i32 = tpl.astype(jnp.int32)

@@ -61,10 +61,12 @@ from pbccs_tpu.models.arrow.params import (
     TRANS_STICK,
     MISMATCH_PROBABILITY,
 )
-from pbccs_tpu.ops.fwdbwd import BandedMatrix, band_offsets
+from pbccs_tpu.ops.fwdbwd import MAX_BAND_ADVANCE, BandedMatrix, band_offsets
 
 _TINY = 1e-30
-_MAX_SHIFT = 7          # band may advance at most 7 rows per column
+# band may advance at most this many rows per column; single source of
+# truth lives in fwdbwd (guided_band_offsets clamps its slope to it)
+_MAX_SHIFT = MAX_BAND_ADVANCE
 _RB = 32                # reads per block (sublane axis)
 _JB = 64                # template columns per grid step
 _UNROLL = 4             # columns per fori_loop iteration
@@ -484,6 +486,21 @@ def _pad_cols(n: int) -> int:
     return ((n + _JB - 1) // _JB) * _JB
 
 
+def _resolve_offsets(offsets, I, J, nc: int, width: int):
+    """Diagonal offsets unless precomputed ones are supplied; pads supplied
+    offsets to nc columns by repeating the last value (slope 0, so the
+    kernel's shift/overflow math never trips on padding columns)."""
+    if offsets is None:
+        return jax.vmap(lambda i, jl: band_offsets(i, jl, nc, width))(I, J)
+    offsets = jnp.asarray(offsets, jnp.int32)
+    if offsets.shape[1] < nc:
+        offsets = jnp.concatenate(
+            [offsets, jnp.broadcast_to(offsets[:, -1:],
+                                       (offsets.shape[0],
+                                        nc - offsets.shape[1]))], axis=1)
+    return offsets[:, :nc]
+
+
 def _pad_reads(r: int) -> int:
     rb = min(_RB, r)
     return ((r + rb - 1) // rb) * rb
@@ -501,10 +518,15 @@ def _pad_r(arrs, R, Rp):
 
 
 def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
-                         pr_miscall: float = MISMATCH_PROBABILITY) -> BandedMatrix:
+                         pr_miscall: float = MISMATCH_PROBABILITY,
+                         offsets=None) -> BandedMatrix:
     """Batched banded forward fills: reads (R, Imax) int8/int32, rlens (R,),
     tpls (R, Jmax), trans (R, Jmax, 4), tlens (R,).  Returns a BandedMatrix
-    with batched leaves (R, Jmax+1, W) / (R, Jmax+1)."""
+    with batched leaves (R, Jmax+1, W) / (R, Jmax+1).
+
+    offsets: optional (R, >= Jmax+1) precomputed band offsets (guided
+    rebanding, fwdbwd.guided_band_offsets); default diagonal layout.
+    Must be monotone with per-column advance <= _MAX_SHIFT."""
     R, Imax = reads.shape
     Jmax = tpls.shape[1]
     nc = _pad_cols(Jmax + 1)
@@ -512,7 +534,7 @@ def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
 
     I = rlens.astype(jnp.int32)
     J = tlens.astype(jnp.int32)
-    offsets = jax.vmap(lambda i, jl: band_offsets(i, jl, nc, width))(I, J)
+    offsets = _resolve_offsets(offsets, I, J, nc, width)
     cm, cd, cc, shifts, mask, seed, seedcol = jax.vmap(
         lambda r, i, t, tr, jl, o: _forward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,
@@ -528,7 +550,8 @@ def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
 
 
 def pallas_backward_batch(reads, rlens, tpls, trans, tlens, width: int,
-                          pr_miscall: float = MISMATCH_PROBABILITY) -> BandedMatrix:
+                          pr_miscall: float = MISMATCH_PROBABILITY,
+                          offsets=None) -> BandedMatrix:
     """Batched banded backward fills; same conventions as
     pallas_forward_batch."""
     R, Imax = reads.shape
@@ -538,7 +561,7 @@ def pallas_backward_batch(reads, rlens, tpls, trans, tlens, width: int,
 
     I = rlens.astype(jnp.int32)
     J = tlens.astype(jnp.int32)
-    offsets = jax.vmap(lambda i, jl: band_offsets(i, jl, nc, width))(I, J)
+    offsets = _resolve_offsets(offsets, I, J, nc, width)
     cm, cd, cc, shifts, mask, seed, seedcol = jax.vmap(
         lambda r, i, t, tr, jl, o: _backward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,

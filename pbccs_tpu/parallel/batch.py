@@ -31,6 +31,7 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
+    effective_band_width,
     revcomp_padded,
     snr_to_transition_table_host,
     template_transition_params,
@@ -42,6 +43,7 @@ from pbccs_tpu.models.arrow.scorer import (
     ADD_SUCCESS,
     fill_alpha_beta_batch_zr,
     fills_use_pallas,
+    guided_fill_passes,
     interior_read_scores,
     oriented_window,
     window_moments,
@@ -90,9 +92,11 @@ class ZmwTask:
     tends: Sequence[int]
 
 
-@functools.partial(jax.jit, static_argnames=("width", "use_pallas", "mesh"))
+@functools.partial(jax.jit, static_argnames=("width", "use_pallas", "mesh",
+                                             "guided_passes"))
 def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
-                 width: int, use_pallas: bool, mesh: Mesh | None = None):
+                 width: int, use_pallas: bool, mesh: Mesh | None = None,
+                 guided_passes: int = 0):
     """Per-ZMW template tracks + per-read window fills + moments.
 
     All leading axes are (Z, ...) with reads (Z, R, Imax).  `tables` are the
@@ -124,7 +128,8 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
         jax.vmap(one_zmw)(tpls, tlens, tables, strands, tstarts, tends)
 
     alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch_zr(
-        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas, mesh)
+        reads, rlens, win_tpl, win_trans, wlens, width, use_pallas, mesh,
+        guided_passes=guided_passes)
     return (win_tpl, win_trans, wlens, alpha, beta,
             ll_a, ll_b, apre, bsuf,
             trans_f, tpl_r, trans_r, table, mu, var)
@@ -356,7 +361,7 @@ class BatchPolisher:
                 self._Jmax = buckets[1]
             else:
                 self._Jmax = max(self._Jmax, buckets[1])
-        self._W = self.config.banding.band_width
+        self._W = effective_band_width(self.config.banding, self._Jmax)
 
         Z, R = self._Z, self._R
         self._snrs = np.full((Z, 4), 8.0)
@@ -520,7 +525,8 @@ class BatchPolisher:
             # jax.shard_map (fill_alpha_beta_batch_zr); pallas_call itself
             # has no GSPMD partitioning rule
             use_pallas=fills_use_pallas(),
-            mesh=self.mesh)
+            mesh=self.mesh,
+            guided_passes=guided_fill_passes(self._Jmax))
         self.alpha, self.beta = alpha, beta
         self._tpl_dev = self._shard(tl)
         self._tpl32_dev = self._tpl_dev.astype(jnp.int32)
@@ -576,7 +582,8 @@ class BatchPolisher:
             g(tl), g(tlens), g(self._host_tables),
             g(self._reads), g(self._rlens), g(self._strands),
             g(self._tstarts), g(self._tends), self._W,
-            use_pallas=fills_use_pallas())
+            use_pallas=fills_use_pallas(),
+            guided_passes=guided_fill_passes(self._Jmax))
         (w_tpl, w_trans, wlens, s_alpha, s_beta, ll_a, ll_b, apre, bsuf,
          trans_f, tpl_r, trans_r, _table, mu, var) = sub
 
@@ -968,7 +975,8 @@ class BatchPolisher:
             separation=opts.mutation_separation,
             neighborhood=opts.mutation_neighborhood,
             chunk=MUT_CHUNK, min_fast_edge=MIN_FAST_EDGE_WLEN,
-            dense=dense_score_enabled(self._Jmax))
+            dense=dense_score_enabled(self._Jmax),
+            guided_passes=guided_fill_passes(self._Jmax))
         loop_args = (st, self._reads_dev, self._rlens_dev,
                      self._strands_dev, self._shard(self._host_tables),
                      self._shard(self._real_rows, 1))
@@ -1376,6 +1384,7 @@ class BatchPolisher:
                                  & real).sum()),
             "dense_kernel_mode": "whole_row" if whole_row else "halo",
             "dense_kernel_vmem_per_cell_bytes": int(vmem_cell),
+            "guided_fill_passes": guided_fill_passes(self._Jmax),
         }
 
     def global_zscores(self) -> np.ndarray:

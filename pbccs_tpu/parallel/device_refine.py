@@ -632,13 +632,14 @@ def run_qv_grid(state: "RefineLoopState", reads, rlens, strands, table,
 
 @functools.partial(jax.jit, static_argnames=(
     "width", "use_pallas", "max_iterations", "separation", "neighborhood",
-    "chunk", "min_fast_edge", "dense", "axis"))
+    "chunk", "min_fast_edge", "dense", "axis", "guided_passes"))
 def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
                     real_rows, *, width: int, use_pallas: bool,
                     max_iterations: int, separation: int,
                     neighborhood: int, chunk: int, min_fast_edge: int,
                     dense: bool = False,
-                    axis: tuple[str, str] | None = None):
+                    axis: tuple[str, str] | None = None,
+                    guided_passes: int = 0):
     """The jitted device refinement loop: up to max_iterations rounds of
     enumerate -> score -> select -> splice -> rebuild entirely on device
     (lax.while_loop with early exit), so the host fetches once.  A
@@ -682,7 +683,8 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         (win_tpl, win_trans, wlens, trans_f, tpl_r, trans_r) = jax.vmap(
             one_zmw)(tpl, tlens, table, strands, tstarts, tends)
         alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch_zr(
-            reads, rlens, win_tpl, win_trans, wlens, width, use_pallas)
+            reads, rlens, win_tpl, win_trans, wlens, width, use_pallas,
+            guided_passes=guided_passes)
         active = batchmod._update_active.__wrapped__(
             active, ll_a, ll_b, rlens, tstarts, tends)
         return (win_tpl, win_trans, wlens, alpha, beta, apre, bsuf,

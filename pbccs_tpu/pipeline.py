@@ -506,7 +506,10 @@ def process_chunks(chunks: Sequence[Chunk],
                 polisher.config,
                 banding=dataclasses.replace(
                     polisher.config.banding,
-                    band_width=2 * polisher.config.banding.band_width))
+                    # 2x the EFFECTIVE width (the W(L) schedule may have
+                    # shrunk the narrow batch below the configured width);
+                    # a non-default width passes through the schedule
+                    band_width=2 * polisher._W))
             try:  # speculative build: any failure keeps the narrow batch
                 from pbccs_tpu.utils import next_pow2
 
@@ -538,7 +541,7 @@ def process_chunks(chunks: Sequence[Chunk],
 
             Logger.default().debug(
                 f"band retry: {len(reband)} ZMW(s) had mating failures at "
-                f"W={polisher.config.banding.band_width}; "
+                f"W={polisher._W}; "
                 f"{len(wide_pick)} adopted the 2x band, "
                 f"{len(reband) - len(wide_pick)} reverted")
         # gate-failed ZMWs are excluded from refinement/QV (the serial path

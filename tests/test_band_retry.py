@@ -38,7 +38,9 @@ def test_retry_attempted_then_reverted(rng):
     L = len(tpl)
     sc = ArrowMultiReadScorer(tpl, snr, list(reads) + [bad],
                               list(strands) + [0], [0] * 5, [L] * 5)
-    assert sc._W == sc.config.banding.band_width  # reverted
+    from pbccs_tpu.models.arrow.params import effective_band_width
+    assert sc._W == effective_band_width(sc.config.banding,
+                                         sc._Jmax)  # reverted
     assert not sc.band_retried
     assert (sc.statuses[:4] == ADD_SUCCESS).all()
     assert sc.statuses[4] == ADD_ALPHABETAMISMATCH
@@ -69,7 +71,8 @@ def test_no_retry_on_clean_zmw(rng):
                               [0] * 5, [L] * 5)
     assert not sc.band_retried
     assert sc.n_band_retries == 0
-    assert sc._W == sc.config.banding.band_width
+    from pbccs_tpu.models.arrow.params import effective_band_width
+    assert sc._W == effective_band_width(sc.config.banding, sc._Jmax)
 
 
 def test_scoring_still_consistent_after_retry_path(rng):
@@ -116,8 +119,7 @@ def _band_retry_pipeline(rng, monkeypatch, drop_in_wide: bool):
         def __init__(self, tasks, **kw):
             super().__init__(tasks, **kw)
             built_widths.append(self._W)
-            narrow = self._W == self.config.banding.band_width \
-                and len(built_widths) == 1
+            narrow = len(built_widths) == 1
             for z, t in enumerate(tasks):
                 if t.id == "rb/1" and (drop_in_wide or narrow):
                     self.statuses[z, len(t.reads) - 1] = \

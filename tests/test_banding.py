@@ -148,3 +148,94 @@ def test_orientation_still_detected_banded(rng):
     for s in summaries:
         lo, hi = s.extent_on_consensus
         assert hi - lo > 0.8 * len(css)
+
+
+# ---------------------------------------------------------------------------
+# guided (argmax-path) recursor rebanding -- fwdbwd.guided_band_offsets,
+# the TPU analogue of the reference's guide-matrix rebanding + flip-flop
+# (reference ConsensusCore/src/C++/Arrow/SimpleRecursor.cpp:642-757)
+# ---------------------------------------------------------------------------
+
+
+def _drifted_fill_case(rng=None, L=2500, W=16):
+    """A template/read pair whose alignment path drifts past W/2 rows off
+    the straight diagonal (small W stands in for 15 kb at CPU test cost;
+    pinned draw: seed 0 / L=2500 / read 0 drifts ~2x the band half-width)."""
+    from pbccs_tpu.simulate import make_transition_track
+
+    rng = rng or np.random.default_rng(0)
+    tpl, reads, strands, snr = simulate_zmw(rng, L, 2)
+    rd = reads[0] if strands[0] == 0 else reads[1]
+    trans = make_transition_track(tpl, snr).astype(np.float32)
+    I, J = len(rd), len(tpl)
+    rpad = np.full(I + 8, 4, np.int8); rpad[:I] = rd
+    tpad = np.full(J + 2, 4, np.int8); tpad[:J] = tpl
+    trpad = np.zeros((J + 2, 4), np.float32); trpad[:J] = trans
+    return rpad, I, tpad, trpad, J, W
+
+
+def test_guided_offsets_invariants():
+    import jax.numpy as jnp
+
+    from pbccs_tpu.ops.fwdbwd import (MAX_BAND_ADVANCE, banded_forward,
+                                      guided_band_offsets)
+
+    rpad, I, tpad, trpad, J, W = _drifted_fill_case()
+    alpha = banded_forward(jnp.asarray(rpad), jnp.int32(I),
+                           jnp.asarray(tpad), jnp.asarray(trpad),
+                           jnp.int32(J), W)
+    off = np.asarray(guided_band_offsets(alpha.vals, alpha.offsets,
+                                         jnp.int32(I), jnp.int32(J), W))
+    d = np.diff(off)
+    assert (d >= 0).all(), "offsets must be monotone"
+    assert (d <= MAX_BAND_ADVANCE).all(), "band advance capped"
+    assert off[0] == 0 and off[1] <= 1, "pinned-start rows stay in band"
+    assert off[J] <= I <= off[J] + W - 1, "pinned corner stays in band"
+
+
+def test_guided_refill_recovers_clipped_likelihood():
+    """With W/2 below the path drift the diagonal band clips probability
+    mass while alpha/beta stay consistent (same band, so the mating gate
+    cannot see it); guided refills must recover strictly more likelihood
+    (keep-better: never less) and keep the fills mated -- the round-4
+    15 kb accuracy failure mode."""
+    import jax.numpy as jnp
+
+    from pbccs_tpu.models.arrow.scorer import fill_alpha_beta_batch
+
+    rpad, I, tpad, trpad, J, W = _drifted_fill_case()
+    args = (jnp.asarray(rpad)[None], jnp.asarray([I], jnp.int32),
+            jnp.asarray(tpad)[None], jnp.asarray(trpad)[None],
+            jnp.asarray([J], jnp.int32))
+    _, _, la0, lb0, _, _ = fill_alpha_beta_batch(*args, W, False,
+                                                 guided_passes=0)
+    _, _, la2, lb2, _, _ = fill_alpha_beta_batch(*args, W, False,
+                                                 guided_passes=2)
+    la0, lb0 = float(la0[0]), float(lb0[0])
+    la2, lb2 = float(la2[0]), float(lb2[0])
+    assert abs(1.0 - la2 / lb2) <= 1e-3, "guided fills must mate"
+    assert la2 > la0 + 30.0, \
+        f"guided refill should recover clipped mass ({la0=} {la2=})"
+
+
+@pytest.mark.parametrize("guided", [1, 2])
+def test_guided_pallas_matches_jax(guided):
+    """Pallas (interpret) and pure-JAX guided fills agree on LLs."""
+    import jax.numpy as jnp
+
+    from pbccs_tpu.models.arrow.scorer import fill_alpha_beta_batch
+
+    rpad, I, tpad, trpad, J, W = _drifted_fill_case(L=300, W=16)
+    args = (jnp.asarray(rpad)[None], jnp.asarray([I], jnp.int32),
+            jnp.asarray(tpad)[None], jnp.asarray(trpad)[None],
+            jnp.asarray([J], jnp.int32))
+    aj, bj, laj, lbj, _, _ = fill_alpha_beta_batch(*args, W, False,
+                                                   guided_passes=guided)
+    ap, bp, lap, lbp, _, _ = fill_alpha_beta_batch(*args, W, True,
+                                                   guided_passes=guided)
+    np.testing.assert_array_equal(np.asarray(aj.offsets),
+                                  np.asarray(ap.offsets)[:, : J + 3])
+    np.testing.assert_allclose(float(laj[0]), float(lap[0]),
+                               rtol=0, atol=2e-3)
+    np.testing.assert_allclose(float(lbj[0]), float(lbp[0]),
+                               rtol=0, atol=2e-3)
