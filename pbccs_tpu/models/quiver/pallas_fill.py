@@ -3,16 +3,21 @@
 Same two-stage design as the Arrow fill kernel (ops/fwdbwd_pallas): an XLA
 coefficient precompute turns the Quiver recurrence
 (reference ConsensusCore/src/C++/Quiver/SimpleRecursor.cpp:62-231, move
-scores QvEvaluator.hpp:160-207) into per-column band coefficients
+scores QvEvaluator.hpp:160-207) into per-column CIRCULAR-lane band
+coefficients (fwdbwd.BandedMatrix: cell (i, j) at lane i mod W)
 
-    col[k] = cm[k] * prev[k + s - 1]        (Incorporate)
-           + cd[k] * prev[k + s]            (Delete)
-           + cg[k] * prev2[k + s2 - 1] / scale_prev   (Merge, j-2)
-           + cc[k] * col[k - 1]             (Extra, in-column)
+    col[L] = cm[L] * roll(prev, 1)[L]       (Incorporate)
+           + cd[L] * prev[L]                (Delete)
+           + cg[L] * roll(prev2, 1)[L] / scale_prev   (Merge, j-2)
+           + cc[L] * col[L-1 circ]          (Extra, in-column)
 
-and the shared column-scan kernel (fwdbwd_pallas._fill_kernel with
-merge=True) runs the sequential scan with the band state -- including the
-two-column Merge carry -- resident in VMEM.  This is the device analogue of
+with all band-membership masks folded into cm/cd/cg and the circular
+scan's cut into cc, and the shared column-scan kernel
+(fwdbwd_pallas._fill_kernel with merge=True) runs the sequential scan
+with the band state -- including the two-column Merge carry -- resident
+in VMEM.  (The circular layout replaced the Merge carry's 15-variant
+dynamic shift-select chain, which made the kernel pathologically slow to
+compile on Mosaic -- the round-4 Quiver compile wall.)  This is the device analogue of
 the reference's SSE recursor (SseRecursor.cpp:66-130): the reference
 vectorizes 4 rows per __m128, here the whole band rides the vector lanes.
 
@@ -36,23 +41,24 @@ from jax import lax
 from pbccs_tpu.models.quiver.params import MERGE, QuiverConfig
 from pbccs_tpu.models.quiver.recursor import QuiverFeatureArrays, _move_params
 from pbccs_tpu.ops.fwdbwd import BandedMatrix, band_offsets
-from pbccs_tpu.ops.fwdbwd_pallas import (_MAX_SHIFT, _edge_clip_rows, _pad_cols,
-                                         _pad_r, _pad_reads, _rev_clip_rows,
-                                         _run_fill, window_rows)
+from pbccs_tpu.ops.fwdbwd_pallas import (_circ_rows_cols, _edge_clip_rows,
+                                         _in_band2, _pad_cols, _pad_r,
+                                         _pad_reads, _rev_clip_rows,
+                                         _run_fill, window_rows_circ)
 
 _TINY = 1e-30
 
 
 def _win(x, starts, W: int, exact: bool = True):
-    """y[j, k] = x[clip(starts[j] + k, .., Imax-1 + 1 pad)] (one row pad)."""
+    """y[j, L] = x[row(L)] over the circular window (one back row pad)."""
     xp = jnp.concatenate([x, x[-1:]])
-    return window_rows(xp, starts, W, exact=exact)
+    return window_rows_circ(xp, starts, W, exact=exact)
 
 
 def _win_m1(x, starts, W: int, exact: bool = True):
-    """y[j, k] = x[starts[j] + k - 1] (front-clipped)."""
+    """y[j, L] = x[row(L) - 1] (front-clipped, circular window)."""
     xp = jnp.concatenate([x[0:1], x])
-    return window_rows(xp, starts, W, exact=exact)
+    return window_rows_circ(xp, starts, W, exact=exact)
 
 
 def _emissions(pp, feat: QuiverFeatureArrays, rows, seq_w, subs_w, ins_w,
@@ -92,20 +98,11 @@ def _forward_coeffs(feat: QuiverFeatureArrays, I, tpl, J, offsets, W: int,
     nc = offsets.shape[0]
     Jmax = tpl.shape[0]
     j = jnp.arange(nc, dtype=jnp.int32)[:, None]
-    k = jnp.arange(W, dtype=jnp.int32)[None, :]
     o = offsets[:, None]
     om1 = _edge_clip_rows(offsets, 1, nc)[:, None]
     om2 = _edge_clip_rows(offsets, 2, nc)[:, None]
-    raw_s = (o - om1)[:, 0]
-    raw_s2 = (o - om2)[:, 0]
-    shifts = jnp.where(jnp.arange(nc) == 0, 0,
-                       jnp.clip(raw_s, 0, _MAX_SHIFT))
-    shifts2 = jnp.where(jnp.arange(nc) < 2, 0,
-                        jnp.clip(raw_s2, 0, 2 * _MAX_SHIFT))
-    overflow = jnp.any(raw_s[1:] > _MAX_SHIFT) | \
-        (jnp.any(raw_s2[2:] > 2 * _MAX_SHIFT) if use_merge else False)
 
-    rows = o + k
+    rows = _circ_rows_cols(offsets, W)
     valid = (rows >= 0) & (rows <= I)
 
     # feature windows at row index rows-1 (Inc/Extra/Merge read base) and
@@ -129,17 +126,19 @@ def _forward_coeffs(feat: QuiverFeatureArrays, I, tpl, J, offsets, W: int,
         pin_s=pin_s, pin_e=pin_e)
 
     live = (j >= 1) & (j <= J)
-    cm = jnp.where(valid & (rows >= 1) & live, inc, 0.0)
-    cd = jnp.where(valid & live, dele, 0.0)
-    cg = jnp.where(valid & (rows >= 1) & live, mrg, 0.0)
+    cm = jnp.where(valid & (rows >= 1) & live
+                   & _in_band2(rows - 1, om1, W), inc, 0.0)
+    cd = jnp.where(valid & live & _in_band2(rows, om1, W), dele, 0.0)
+    cg = jnp.where(valid & (rows >= 1) & live
+                   & _in_band2(rows - 1, om2, W), mrg, 0.0)
     # column 0 chains Extra below the alpha(0,0) impulse; dead cols j > J
-    # have no in-column move
-    cc = jnp.where(valid & (rows >= 1) & (j <= J), extra, 0.0)
+    # have no in-column move; rows > o cuts the circular scan at the
+    # band's first row
+    cc = jnp.where(valid & (rows >= 1) & (j <= J) & (rows > o), extra, 0.0)
 
     mask = (j[:, 0] <= J).astype(jnp.float32)
-    seed = jnp.where(overflow, 0.0,
-                     (jnp.arange(W) == 0).astype(jnp.float32))
-    return cm, cd, cc, cg, shifts, shifts2, mask, seed, jnp.int32(0)
+    seed = (jnp.arange(W) == 0).astype(jnp.float32)
+    return cm, cd, cc, cg, mask, seed, jnp.int32(0)
 
 
 def _backward_coeffs(feat: QuiverFeatureArrays, I, tpl, J, offsets, W: int,
@@ -149,30 +148,24 @@ def _backward_coeffs(feat: QuiverFeatureArrays, I, tpl, J, offsets, W: int,
     recursor.quiver_backward column for column."""
     nc = offsets.shape[0]
     Jmax = tpl.shape[0]
-    k = jnp.arange(W, dtype=jnp.int32)[None, :]
     cc_idx = jnp.arange(nc, dtype=jnp.int32)[:, None]
     j = Jmax - cc_idx
-    o_j = _rev_clip_rows(offsets, Jmax, nc)[:, None]
+    o_jv = _rev_clip_rows(offsets, Jmax, nc)
+    o_j = o_jv[:, None]
     o_j1 = _rev_clip_rows(offsets, Jmax + 1, nc)[:, None]
     o_j2 = _rev_clip_rows(offsets, Jmax + 2, nc)[:, None]
-    raw_s = (o_j1 - o_j)[:, 0]
-    raw_s2 = (o_j2 - o_j)[:, 0]
-    shifts = jnp.clip(raw_s, 0, _MAX_SHIFT)
-    shifts2 = jnp.clip(raw_s2, 0, 2 * _MAX_SHIFT)
-    overflow = jnp.any(raw_s > _MAX_SHIFT) | \
-        (jnp.any(raw_s2 > 2 * _MAX_SHIFT) if use_merge else False)
 
-    rows = o_j + (W - 1 - k)
+    rows = _circ_rows_cols(o_jv, W)
     valid = (rows >= 0) & (rows <= I)
 
-    # all backward lookups are at row index `rows` (lane-reversed windows)
-    rev = lambda a: a[:, ::-1]
-    seq_0 = rev(_win(feat.seq.astype(jnp.float32), o_j[:, 0], W, exact=False))
-    subs_0 = rev(_win(feat.subs_qv, o_j[:, 0], W))
-    ins_0 = rev(_win(feat.ins_qv, o_j[:, 0], W))
-    mqv_0 = rev(_win(feat.merge_qv, o_j[:, 0], W))
-    dtag_0 = rev(_win(feat.del_tag, o_j[:, 0], W, exact=False))
-    dqv_0 = rev(_win(feat.del_qv, o_j[:, 0], W))
+    # all backward lookups are at row index `rows` (shared circular lanes;
+    # no lane reversal -- the kernel's backward mode rolls the other way)
+    seq_0 = _win(feat.seq.astype(jnp.float32), o_jv, W, exact=False)
+    subs_0 = _win(feat.subs_qv, o_jv, W)
+    ins_0 = _win(feat.ins_qv, o_jv, W)
+    mqv_0 = _win(feat.merge_qv, o_jv, W)
+    dtag_0 = _win(feat.del_tag, o_jv, W, exact=False)
+    dqv_0 = _win(feat.del_qv, o_jv, W)
 
     tb = _rev_clip_rows(tpl, Jmax, nc)[:, None]            # base j (clipped)
     tb_next = _rev_clip_rows(tpl, Jmax + 1, nc)[:, None]   # base j+1
@@ -184,18 +177,18 @@ def _backward_coeffs(feat: QuiverFeatureArrays, I, tpl, J, offsets, W: int,
         pin_s=pin_s, pin_e=pin_e)
 
     live = (j >= 0) & (j < J)
-    cm = jnp.where(valid & (rows < I) & live, inc, 0.0)
-    cd = jnp.where(valid & live, dele, 0.0)
-    cg = jnp.where(valid & (rows < I) & live, mrg, 0.0)
-    cc = jnp.where(valid & (rows < I) & (j >= 0) & (j <= J), extra, 0.0)
+    cm = jnp.where(valid & (rows < I) & live
+                   & _in_band2(rows + 1, o_j1, W), inc, 0.0)
+    cd = jnp.where(valid & live & _in_band2(rows, o_j1, W), dele, 0.0)
+    cg = jnp.where(valid & (rows < I) & live
+                   & _in_band2(rows + 1, o_j2, W), mrg, 0.0)
+    # rows < o + W - 1 cuts the reverse circular scan at the band top
+    cc = jnp.where(valid & (rows < I) & (j >= 0) & (j <= J)
+                   & (rows < o_j + W - 1), extra, 0.0)
 
     mask = ((j[:, 0] >= 0) & (j[:, 0] <= J)).astype(jnp.float32)
-    oJ = jnp.take(offsets, jnp.clip(J, 0, nc - 1))
-    seed_lane = W - 1 - (I - oJ)
-    seed = jnp.where(
-        overflow, 0.0,
-        (jnp.arange(W) == jnp.clip(seed_lane, 0, W - 1)).astype(jnp.float32))
-    return cm, cd, cc, cg, shifts, shifts2, mask, seed, \
+    seed = (jnp.arange(W) == I % W).astype(jnp.float32)
+    return cm, cd, cc, cg, mask, seed, \
         (Jmax - J).astype(jnp.int32)
 
 
@@ -216,10 +209,9 @@ def _batch(coeff_fn, feat, rlens, tpls, tlens, config, W, pin_start, pin_end,
             f, i, t.astype(jnp.int32), jl, o, W, pp, use_merge,
             jnp.asarray(pin_start), jnp.asarray(pin_end))
     )(feat, I, tpls, J, offsets)
-    cm, cd, cc, cg, shifts, shifts2, mask, seed, seedcol = _pad_r(
-        list(outs), R, Rp)
-    vals, ls = _run_fill(cm, cd, cc, shifts, mask, seed, seedcol,
-                         rev_store=rev_store, shifts2=shifts2, cg=cg)
+    cm, cd, cc, cg, mask, seed, seedcol = _pad_r(list(outs), R, Rp)
+    vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol,
+                         rev_store=rev_store, cg=cg)
     return vals, ls, offsets, nc
 
 
@@ -250,7 +242,7 @@ def pallas_quiver_backward_batch(feat: QuiverFeatureArrays, rlens, tpls,
     R = rlens.shape[0]
     Jmax = tpls.shape[1]
     lo = nc - 1 - Jmax
-    return BandedMatrix(vals[:R, lo: lo + Jmax + 1, ::-1],
+    return BandedMatrix(vals[:R, lo: lo + Jmax + 1],
                         offsets[:, : Jmax + 1], ls[:R, lo: lo + Jmax + 1])
 
 
@@ -259,10 +251,12 @@ def quiver_loglik_batch(alpha: BandedMatrix, rlens, tlens):
     Quiver final column is a full band, so the pick is a 2-axis mask)."""
     I = rlens.astype(jnp.int32)[:, None]
     J = tlens.astype(jnp.int32)[:, None]
+    from pbccs_tpu.ops.fwdbwd import circ_rows
     ncols = alpha.vals.shape[1]
+    W = alpha.vals.shape[2]
     jcols = jnp.arange(ncols, dtype=jnp.int32)[None, :]
     at_J = (jcols == J)[:, :, None]
-    rows = alpha.offsets[:, :, None] + jnp.arange(alpha.vals.shape[2])[None, None, :]
+    rows = circ_rows(alpha.offsets, W)         # circular lane -> row
     final = jnp.sum(jnp.where(at_J & (rows == I[:, :, None]),
                               alpha.vals, 0.0), axis=(1, 2))
     ls = jnp.sum(jnp.where(jcols <= J, alpha.log_scales, 0.0), axis=1)

@@ -30,7 +30,8 @@ import numpy as np
 from jax import lax
 
 from pbccs_tpu.models.quiver.params import MERGE, QuiverConfig, QvModelParams
-from pbccs_tpu.ops.fwdbwd import BandedMatrix, _affine_scan, _gather_band, band_offsets
+from pbccs_tpu.ops.fwdbwd import (BandedMatrix, _affine_scan_circ,
+                                  _gather_band, band_offsets, circ_rows)
 
 _TINY = 1e-30
 
@@ -131,10 +132,12 @@ def quiver_forward(feat: QuiverFeatureArrays, read_len, tpl, tpl_len,
 
     col0_rows = jnp.arange(W, dtype=jnp.int32)
     # column 0: alpha(0,0)=1; alpha(i,0) = alpha(i-1,0)*Extra(i-1, 0)
+    # (offsets[0] == 0, so circular lanes == rows and c0 is already zero
+    # at the scan's cut lane 0)
     b0 = jnp.zeros(W).at[0].set(1.0)
     c0 = jnp.where((col0_rows >= 1) & (col0_rows <= I),
                    jnp.exp(_extra(pp, feat, col0_rows - 1, tpl32[0], J > 0)), 0.0)
-    col0 = _affine_scan(b0, c0)
+    col0 = _affine_scan_circ(b0, c0)
     s0 = jnp.maximum(jnp.max(col0), _TINY)
     col0 = col0 / s0
     ls0 = jnp.log(s0)
@@ -142,7 +145,7 @@ def quiver_forward(feat: QuiverFeatureArrays, read_len, tpl, tpl_len,
     def step(carry, j):
         prev, prev_off, prev2, prev2_off, s_prev = carry
         o = offsets[j]
-        rows = o + jnp.arange(W, dtype=jnp.int32)
+        rows = circ_rows(o, W)
         valid = (rows >= 0) & (rows <= I)
         tb_prev = tpl32[jnp.clip(j - 1, 0, Jmax - 1)]      # template base j-1
         tb_cur = tpl32[jnp.clip(j, 0, Jmax - 1)]
@@ -162,8 +165,9 @@ def quiver_forward(feat: QuiverFeatureArrays, read_len, tpl, tpl_len,
         b = jnp.where(valid, b, 0.0)
 
         ext = jnp.exp(_extra(pp, feat, rows - 1, tb_cur, j < J))
-        c = jnp.where(valid & (rows >= 1), ext, 0.0)
-        col = _affine_scan(b, c)
+        # rows > o cuts the circular scan at the band's first row
+        c = jnp.where(valid & (rows >= 1) & (rows > o), ext, 0.0)
+        col = _affine_scan_circ(b, c)
 
         active = j <= J
         cmax = jnp.max(col)
@@ -201,7 +205,7 @@ def quiver_backward(feat: QuiverFeatureArrays, read_len, tpl, tpl_len,
 
     def col_fill(j, nxt, nxt_off, nxt2, nxt2_off, s_next, seedcol):
         o = offsets[jnp.clip(j, 0, Jmax)]
-        rows = o + jnp.arange(W, dtype=jnp.int32)
+        rows = circ_rows(o, W)
         valid = (rows >= 0) & (rows <= I)
         tb = tpl32[jnp.clip(j, 0, Jmax - 1)]
         tb_next = tpl32[jnp.clip(j + 1, 0, Jmax - 1)]
@@ -220,8 +224,9 @@ def quiver_backward(feat: QuiverFeatureArrays, read_len, tpl, tpl_len,
         b = jnp.where(valid, b, 0.0)
 
         ext = jnp.exp(_extra(pp, feat, rows, tb, j < J))
-        c = jnp.where(valid & (rows < I), ext, 0.0)
-        return _affine_scan(b, c, reverse=True), o
+        # rows < o + W - 1 cuts the reverse circular scan at the band top
+        c = jnp.where(valid & (rows < I) & (rows < o + W - 1), ext, 0.0)
+        return _affine_scan_circ(b, c, reverse=True), o
 
     def step(carry, j):
         nxt, nxt_off, nxt2, nxt2_off, s_next = carry

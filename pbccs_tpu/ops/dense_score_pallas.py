@@ -54,7 +54,8 @@ from pbccs_tpu.models.arrow.params import (
     TRANS_STICK,
     context_index,
 )
-from pbccs_tpu.ops.fwdbwd import BandedMatrix
+from pbccs_tpu.ops.fwdbwd import (BandedMatrix, _affine_scan_circ,
+                                  circ_roll, circ_rows)
 
 _TINY = 1e-30
 _PB = 64          # template positions per kernel grid cell
@@ -185,35 +186,9 @@ def dense_patch_grids(win_tpl, win_trans, table, wl):
 # --------------------------------------------------------------------------
 
 
-def _shift_lanes(x, t: int):
-    """y[..., k] = x[..., k+t] (zeros outside); static t, may be negative."""
-    if t == 0:
-        return x
-    z = jnp.zeros(x.shape[:-1] + (abs(t),), x.dtype)
-    if t > 0:
-        return jnp.concatenate([x[..., t:], z], axis=-1)
-    return jnp.concatenate([z, x[..., :t]], axis=-1)
-
-
-def _select_shift(x, d, lo: int, hi: int):
-    """y[m, k] = x[m, k + clip(d[m], lo, hi)] (zeros outside the band)."""
-    r = jnp.clip(d, lo, hi)
-    out = jnp.zeros_like(x)
-    for t in range(lo, hi + 1):
-        out = jnp.where(r == t, _shift_lanes(x, t), out)
-    return out
-
-
-def _hs_scan(b, c, W: int):
-    """Hillis-Steele solve of v[k] = b[k] + c[k] * v[k-1] along lanes."""
-    d = 1
-    while d < W:
-        f = jnp.full(b.shape[:-1] + (min(d, b.shape[-1]),), 0.0, b.dtype)
-        fc = jnp.ones_like(f)
-        b = b + c * jnp.concatenate([f, b[..., :-d]], axis=-1)
-        c = c * jnp.concatenate([fc, c[..., :-d]], axis=-1)
-        d *= 2
-    return b
+# shared circular-layout helpers (single source of truth in ops.fwdbwd)
+_shift_lanes_circ = circ_roll
+_hs_scan_circ = lambda b, c, W: _affine_scan_circ(b, c)
 
 
 def _dense_kernel(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
@@ -261,15 +236,25 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     # ENTIRE padded row (VMEM-resident; Pallas skips the re-fetch across
     # the b axis since the index map repeats) and the halo'd per-block
     # views never materialize in HBM.
+    def crows(o_col):
+        """(PB, W) absolute row per circular lane for (PB, 1) per-position
+        offsets (fwdbwd.circ_rows over the position axis)."""
+        return circ_rows(o_col[:, 0], W)
 
-    def ext_col(prev, d, o_col, rbase, cur_b, next_b, prev_tr, cur_tr):
+    def in_band(rows, o):
+        return (rows >= o) & (rows < o + W)
+
+    def ext_col(prev, o_prev, o_col, rows, rbase, cur_b, next_b,
+                prev_tr, cur_tr):
         """One interior ExtendAlpha column over (_PB, W); mirrors
-        ops.mutation_score._ext_col with the interior-only masks."""
-        rows = o_col + lax.broadcasted_iota(jnp.int32, (1, W), 1)
+        ops.mutation_score._ext_col with the interior-only masks.
+        Circular lanes: the cross-column operand is one static roll +
+        in-band mask (any offset delta), replacing the bounded
+        shift-variant selects."""
         in_read = (rows >= 1) & (rows <= I)
         em = jnp.where(rbase == cur_b, hit, miss)
-        pm1 = _select_shift(prev, d - 1, -1, 7)
-        p0 = _select_shift(prev, d, 0, 7)
+        pm1 = jnp.where(in_band(rows - 1, o_prev), _shift_lanes_circ(prev, 1), 0.0)
+        p0 = jnp.where(in_band(rows, o_prev), prev, 0.0)
         b = pm1 * em * jnp.where(rows < I, prev_tr[:, TRANS_MATCH:TRANS_MATCH + 1], 0.0)
         b = b + jnp.where(rows != I,
                           p0 * prev_tr[:, TRANS_DARK:TRANS_DARK + 1], 0.0)
@@ -277,15 +262,15 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         ins_em = jnp.where(rbase == next_b,
                            cur_tr[:, TRANS_BRANCH:TRANS_BRANCH + 1],
                            cur_tr[:, TRANS_STICK:TRANS_STICK + 1] / 3.0)
-        c = jnp.where(in_read & (rows > 1) & (rows < I), ins_em, 0.0)
-        return _hs_scan(b, c, W)
+        c = jnp.where(in_read & (rows > 1) & (rows < I) & (rows > o_col),
+                      ins_em, 0.0)
+        return _hs_scan_circ(b, c, W)
 
-    def link(ext1, o_s1, rn_s1, link_tr, link_b, bcol, d_b, lo: int,
-             apre_s, bsuf_b):
-        rows = o_s1 + lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    def link(ext1, rows, rn_s1, link_tr, link_b, bcol, o_b, apre_s, bsuf_b):
         em_link = jnp.where(rn_s1 == link_b, hit, miss)
-        beta_ip1 = _select_shift(bcol, d_b + 1, lo + 1, 1)
-        beta_i = _select_shift(bcol, d_b, lo, 0)
+        beta_ip1 = jnp.where(in_band(rows + 1, o_b),
+                             _shift_lanes_circ(bcol, -1), 0.0)
+        beta_i = jnp.where(in_band(rows, o_b), bcol, 0.0)
         match = jnp.where(rows < I,
                           ext1 * link_tr[:, TRANS_MATCH:TRANS_MATCH + 1]
                           * em_link * beta_ip1, 0.0)
@@ -308,6 +293,7 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     w_m2, w_m1 = at(wtpl_ref, -2), at(wtpl_ref, -1)
     w_0, w_p1 = at(wtpl_ref, 0), at(wtpl_ref, 1)
     wt_m3, wt_m2 = at(wtr_ref, -3), at(wtr_ref, -2)
+    rows_m1, rows_0, rows_p1 = crows(o_m1), crows(o_0), crows(o_p1)
 
     outs = [None] * N_SLOTS
     # ---- SUB + INS slots (s = p): patch = [prev_b, nb] --------------
@@ -323,20 +309,20 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         t1i = pt_ref[pl.dslice(base_off + _OFF0, _PB),
                       pl.dslice((8 + b * 2 + 1) * 4, 4)]
         nb = jnp.float32(b)
-        ext0 = ext_col(a_m1, o_0 - o_m1, o_0, rb_0, w_m1, nb, wt_m2, t0)
-        ext1s = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_p1, t0, t1s)
-        outs[b] = link(ext1s, o_p1, rn_p1, t1s, w_p1, b_p2,
-                       o_p1 - o_p2, -7, ap_0, bs_p2)
-        ext1i = ext_col(ext0, o_p1 - o_0, o_p1, rb_p1, nb, w_0, t0, t1i)
-        outs[4 + b] = link(ext1i, o_p1, rn_p1, t1i, w_0, b_p1,
-                           jnp.zeros_like(o_p1), -1, ap_0, bs_p1)
+        ext0 = ext_col(a_m1, o_m1, o_0, rows_0, rb_0, w_m1, nb, wt_m2, t0)
+        ext1s = ext_col(ext0, o_0, o_p1, rows_p1, rb_p1, nb, w_p1, t0, t1s)
+        outs[b] = link(ext1s, rows_p1, rn_p1, t1s, w_p1, b_p2,
+                       o_p2, ap_0, bs_p2)
+        ext1i = ext_col(ext0, o_0, o_p1, rows_p1, rb_p1, nb, w_0, t0, t1i)
+        outs[4 + b] = link(ext1i, rows_p1, rn_p1, t1i, w_0, b_p1,
+                           o_p1, ap_0, bs_p1)
     # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
     t0 = pt_ref[pl.dslice(base_off + _OFF0, _PB), pl.dslice(16 * 4, 4)]
-    ext0 = ext_col(a_m2, o_m1 - o_m2, o_m1, rb_m1, w_m2, w_m1,
+    ext0 = ext_col(a_m2, o_m2, o_m1, rows_m1, rb_m1, w_m2, w_m1,
                    wt_m3, wt_m2)
-    ext1 = ext_col(ext0, o_0 - o_m1, o_0, rb_0, w_m1, w_p1, wt_m2, t0)
-    outs[8] = link(ext1, o_0, rn_0, t0, w_p1, b_p2,
-                   o_0 - o_p2, -14, ap_m1, bs_p2)
+    ext1 = ext_col(ext0, o_m1, o_0, rows_0, rb_0, w_m1, w_p1, wt_m2, t0)
+    outs[8] = link(ext1, rows_0, rn_0, t0, w_p1, b_p2,
+                   o_p2, ap_m1, bs_p2)
 
     out_ref[...] = jnp.stack(outs, axis=1)
 
@@ -377,7 +363,7 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     unmutated windows; apre/bsuf (R, nc+1) scale prefixes.  Entry [r, p, k]
     is the absolute mutated-window log-likelihood of slot (p, k) for read
     r, valid where the caller's interior classification holds."""
-    from pbccs_tpu.ops.fwdbwd_pallas import window_rows
+    from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
 
     R, Imax = reads.shape
     Jm = win_tpl.shape[1]
@@ -386,9 +372,9 @@ def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
     jm_pad = ((Jm + _PB - 1) // _PB) * _PB
 
     read_f = jax.vmap(lambda r: r.astype(jnp.float32))(reads)
-    rbase = jax.vmap(lambda rf, o: window_rows(
+    rbase = jax.vmap(lambda rf, o: window_rows_circ(
         jnp.concatenate([rf[0:1], rf]), o, W))(read_f, alpha.offsets)
-    rnext = jax.vmap(lambda rf, o: window_rows(rf, o, W))(
+    rnext = jax.vmap(lambda rf, o: window_rows_circ(rf, o, W))(
         read_f, alpha.offsets)
 
     if ptrans is None:
@@ -494,6 +480,19 @@ _NE_MASK9 = np.array([[True] * 4 + [False] * 4 + [True],
                       [True] * 9])
 
 
+def _read_window_circ(read_pad, o, W: int):
+    """read_pad[circ_rows(o)[L]] via two contiguous dynamic slices + one
+    select (the circular window splits at the lane wrap); read_pad must
+    extend 2W past the largest start."""
+    o = jnp.asarray(o, jnp.int32)
+    q = o % W
+    b = o - q
+    s1 = lax.dynamic_slice(read_pad, (b,), (W,))
+    s2 = lax.dynamic_slice(read_pad, (b + W,), (W,))
+    L = jnp.arange(W, dtype=jnp.int32)
+    return jnp.where(L >= q, s1, s2)
+
+
 def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
                   *, W: int):
     """Near-begin scores of one read: (27,) absolute LLs for slots at
@@ -501,15 +500,16 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     near-begin branch: refill virtual DP columns 1..4 from the pinned
     start, LinkAlphaBeta at virtual column 4 against saved beta column
     5 - ld."""
-    from pbccs_tpu.ops.mutation_score import _ext_col, _select_shift
+    from pbccs_tpu.ops.mutation_score import (_circ_rows_batch, _ext_col,
+                                              _in_band)
 
     eps = MISMATCH_PROBABILITY
     hit, em_miss = 1.0 - eps, eps / 3.0
     M = 27
     tplf = tpl.astype(jnp.float32)
     readf = read.astype(jnp.float32)
-    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(W)])
-    read_pad0 = jnp.concatenate([readf, jnp.zeros(W + 1)])
+    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(2 * W)])
+    read_pad0 = jnp.concatenate([readf, jnp.zeros(2 * W + 1)])
     maxl = J + jnp.asarray(_LD27, jnp.int32)
 
     # per-slot virtual template bases/trans at static absolute window
@@ -553,9 +553,8 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     o_prev = offs[0]
     for j in range(1, 5):
         o_j = offs[j]
-        rb_j = jnp.broadcast_to(
-            lax.dynamic_slice(read_pad1, (o_j,), (W,)), (M, W))
-        ext = one_col(ext, jnp.broadcast_to(o_j - o_prev, (M,)),
+        rb_j = jnp.broadcast_to(_read_window_circ(read_pad1, o_j, W), (M, W))
+        ext = one_col(ext, jnp.broadcast_to(o_prev, (M,)),
                       jnp.broadcast_to(o_j, (M,)), rb_j,
                       jnp.full((M,), j, jnp.int32),
                       vB(j - 1), vB(j), vT(j - 2), vT(j - 1))
@@ -565,16 +564,15 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     B_col = bvals[blc]                                   # (27, W)
     o_b = boffs[blc]
     bsuf_b = bsuf[blc]
-    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
-    rows4 = offs[4] + karange
+    rows4 = _circ_rows_batch(jnp.broadcast_to(offs[4], (M,)), W)
     link_tr = vT(3)
     link_b = vB(4)
-    rn4 = jnp.broadcast_to(
-        lax.dynamic_slice(read_pad0, (offs[4],), (W,)), (M, W))
+    rn4 = jnp.broadcast_to(_read_window_circ(read_pad0, offs[4], W), (M, W))
     em_link = jnp.where(rn4 == link_b[:, None], hit, em_miss)
-    d_b = jnp.broadcast_to(offs[4], (M,)) - o_b
-    beta_ip1 = _select_shift(B_col, d_b + 1, -21, 1)
-    beta_i = _select_shift(B_col, d_b, -22, 0)
+    from pbccs_tpu.ops.fwdbwd import circ_roll
+    beta_ip1 = jnp.where(_in_band(rows4 + 1, o_b, W),
+                         circ_roll(B_col, -1), 0.0)
+    beta_i = jnp.where(_in_band(rows4, o_b, W), B_col, 0.0)
     match = jnp.where(rows4 < I, ext * link_tr[:, TRANS_MATCH][:, None]
                       * em_link * beta_ip1, 0.0)
     dele = ext * link_tr[:, TRANS_DARK][:, None] * beta_i
@@ -598,7 +596,7 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
     nc = avals.shape[0]
     tplf = tpl.astype(jnp.float32)
     readf = read.astype(jnp.float32)
-    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(W)])
+    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(2 * W)])
     maxl = J + jnp.asarray(_LD27, jnp.int32)
 
     # J-relative contiguous slices (padded so no dynamic_slice clamping)
@@ -611,7 +609,7 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
     transS = lax.dynamic_slice(
         jnp.concatenate([trans, jnp.zeros((3, 4))]), (J - 6, 0), (9, 4))
     ptS = lax.dynamic_slice(ptrans, (J - 2, 0, 0, 0), (3, 9, 2, 4))
-    rb6 = jnp.stack([lax.dynamic_slice(read_pad1, (offs7[i],), (W,))
+    rb6 = jnp.stack([_read_window_circ(read_pad1, offs7[i], W)
                      for i in range(1, 7)])                  # cols J-3..J+2
 
     # t = s - (J-4) in {1..4}, static per slot (s = p - [k==del])
@@ -669,18 +667,19 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
 
     one_col = functools.partial(_ext_col, I=I, max_left=maxl,
                                 hit=hit, em_miss=em_miss, W=W)
-    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s_col,
+    ext0 = one_col(A_prev, o_sm1, o_s, rb_s, s_col,
                    vB_rel(-1), vB_rel(0), vT_rel(-2), vT_rel(-1))
-    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s_col + 1,
+    ext1 = one_col(ext0, o_s, o_s1, rb_s1, s_col + 1,
                    vB_rel(0), vB_rel(1), vT_rel(-1), vT_rel(0))
-    ext2 = one_col(ext1, o_s2 - o_s1, o_s2, rb_s2, s_col + 2,
+    ext2 = one_col(ext1, o_s1, o_s2, rb_s2, s_col + 2,
                    vB_rel(1), vB_rel(2), vT_rel(0), vT_rel(1))
 
     kstar = maxl - s_col                                     # 1 or 2
     corner_vals = jnp.where((kstar == 1)[:, None], ext1, ext2)
     o_corner = jnp.where(kstar == 1, o_s1, o_s2)
     karange = jnp.arange(W, dtype=jnp.int32)[None, :]
-    corner = jnp.sum(jnp.where(karange == (I - o_corner)[:, None],
+    in_b = ((I >= o_corner) & (I < o_corner + W))[:, None]
+    corner = jnp.sum(jnp.where((karange == (I % W)) & in_b,
                                corner_vals, 0.0), axis=1)
     return jnp.log(jnp.maximum(corner, _TINY)) + apre_s
 

@@ -54,13 +54,25 @@ _TINY = 1e-30
 
 
 class BandedMatrix(NamedTuple):
-    """A column-banded DP matrix.
+    """A column-banded DP matrix in CIRCULAR lane layout.
 
-    vals:       (Jmax+1, W) band values; vals[j, k] is matrix cell
-                (offsets[j] + k, j), rescaled so each column's max is 1.
+    vals:       (Jmax+1, W) band values; vals[j, L] is matrix cell (r, j)
+                for the unique in-band row r with r === L (mod W), i.e.
+                r = circ_rows(offsets[j], W)[L]; rescaled so each column's
+                max is 1.
     offsets:    (Jmax+1,) int32 first row of each column's band.
     log_scales: (Jmax+1,) accumulated log column scale factors.
-    """
+
+    Why circular: cell (i, j) always lives at lane i mod W whatever the
+    column's offset, so the cross-column band alignment of every DP
+    recurrence is a STATIC lane rotation (roll by +-1) plus an in-band
+    mask -- the per-column dynamic shift-variant select chains this
+    replaced were the dominant VPU op count of the fill and mutation
+    kernels and the source of the Mosaic compile blowup at long
+    templates (8 variants for Arrow, 15 for the Quiver merge carry).
+    Lane-permutation-invariant consumers (column max/sum reductions,
+    occupancy counters, log-likelihood extraction via one-hot) are
+    unchanged by construction."""
 
     vals: jax.Array
     offsets: jax.Array
@@ -85,10 +97,57 @@ def band_offsets(read_len, tpl_len, n_cols: int, width: int):
     return jnp.clip(off, 0, hi)
 
 
-#: Maximum band advance per template column representable by the Pallas
-#: fill kernel's shift-variant select (ops/fwdbwd_pallas._MAX_SHIFT).
-#: guided_band_offsets clamps its output slope to this so guided fills
-#: never trip the kernel's overflow drop.
+def circ_rows(offset, width: int):
+    """(..., W) absolute row of each circular lane for columns with band
+    offsets `offset` (scalar or any-shape array; a trailing lane axis is
+    appended): lane L holds the unique row r in [offset, offset+W) with
+    r === L (mod W)."""
+    offset = jnp.asarray(offset, jnp.int32)[..., None]
+    L = jnp.arange(width, dtype=jnp.int32)
+    q = offset % width
+    return offset - q + L + jnp.where(L < q, width, 0)
+
+
+def circ_roll(x, t: int):
+    """Circular lane roll: y[..., L] = x[..., (L - t) mod W] (static t).
+    t=+1 aligns the previous row's value under each lane (row r-1 lives at
+    lane L-1); t=-1 the next row's."""
+    if t == 0:
+        return x
+    W = x.shape[-1]
+    t = t % W
+    return jnp.concatenate([x[..., W - t:], x[..., : W - t]], axis=-1)
+
+
+def in_band(rows, offset, width: int):
+    """Mask: absolute row inside the band [offset, offset+W) of a column
+    with this offset (shapes broadcast)."""
+    return (rows >= offset) & (rows < offset + width)
+
+
+def _affine_scan_circ(b, c, reverse: bool = False):
+    """Hillis-Steele solve of v[L] = b[L] + c[L] * v[L-1] over CIRCULAR
+    lanes (reverse: v[L] = b[L] + c[L] * v[L+1]).
+
+    Correct iff the caller zeroed c at the scan's cut lane (the band's
+    first row forward / last row backward): every wrapped contribution's
+    cumulative c-product then contains that zero, so the circular rolls
+    never leak mass across the band boundary."""
+    W = b.shape[-1]
+    t = -1 if reverse else 1
+    d = 1
+    while d < W:
+        b = b + c * circ_roll(b, t * d)
+        c = c * circ_roll(c, t * d)
+        d *= 2
+    return b
+
+
+#: Slope clamp of guided_band_offsets (rows of band advance per template
+#: column).  A banding-QUALITY choice, not a kernel constraint: the
+#: circular-lane kernels handle arbitrary per-column advance via in-band
+#: masks; the clamp just keeps re-centered bands smooth so adjacent
+#: columns overlap enough to carry probability mass.
 MAX_BAND_ADVANCE = 7
 
 
@@ -121,7 +180,10 @@ def guided_band_offsets(alpha_vals, alpha_offsets, read_len, tpl_len,
     J = jnp.asarray(tpl_len, jnp.int32)
     j = jnp.arange(ncA, dtype=jnp.float32)
 
-    c = (alpha_offsets + jnp.argmax(alpha_vals, axis=-1)).astype(jnp.float32)
+    lane = jnp.argmax(alpha_vals, axis=-1).astype(jnp.int32)
+    q = alpha_offsets % W                  # circular layout: lane -> row
+    c = (alpha_offsets - q + lane
+         + jnp.where(lane < q, W, 0)).astype(jnp.float32)
     c = jnp.where(j <= J, c, I.astype(jnp.float32))
     c = jnp.minimum(c, I.astype(jnp.float32))
     if smooth:
@@ -163,10 +225,11 @@ def _affine_scan(b: jax.Array, c: jax.Array, reverse: bool = False) -> jax.Array
 
 
 def _gather_band(col_vals, col_offset, rows):
-    """Read band column values at absolute `rows` (vector); 0 outside band."""
-    idx = rows - col_offset
-    ok = (idx >= 0) & (idx < col_vals.shape[-1])
-    return jnp.where(ok, jnp.take(col_vals, jnp.clip(idx, 0, col_vals.shape[-1] - 1), axis=-1), 0.0)
+    """Read band column values at absolute `rows` (vector); 0 outside band.
+    col_vals are in circular lane layout: row r lives at lane r mod W."""
+    W = col_vals.shape[-1]
+    ok = (rows >= col_offset) & (rows < col_offset + W)
+    return jnp.where(ok, jnp.take(col_vals, rows % W, axis=-1), 0.0)
 
 
 def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
@@ -206,7 +269,7 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
     def step(carry, j):
         prev_vals, prev_off = carry
         o = offsets[j]
-        rows = o + jnp.arange(W, dtype=jnp.int32)          # absolute row ids
+        rows = circ_rows(o, W)                             # absolute row ids
         rbase = jnp.take(read_i32, jnp.clip(rows - 1, 0, Imax - 1))
         t_cur = tpl_i32[j - 1]
         t_next = tpl_i32[jnp.minimum(j, Jmax - 1)]
@@ -231,9 +294,11 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
         b = jnp.where(valid, b, 0.0)
 
         ins = jnp.where(rbase == t_next, tr_cur[TRANS_BRANCH], tr_cur[TRANS_STICK] / 3.0)
-        c = jnp.where(valid & (rows > 1), ins, 0.0)
+        # rows > o additionally cuts the circular scan at the band's first
+        # row (its in-column predecessor is out of band)
+        c = jnp.where(valid & (rows > 1) & (rows > o), ins, 0.0)
 
-        col = _affine_scan(b, c)
+        col = _affine_scan_circ(b, c)
 
         active = j < J
         cmax = jnp.max(col)
@@ -260,8 +325,7 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
     em_last = jnp.where(read_i32[jnp.clip(I - 1, 0, Imax - 1)] == tpl_i32[jnp.clip(J - 1, 0, Jmax - 1)],
                         em_hit, em_miss)
     final = a_prev * em_last
-    oJ = offsets[J]
-    vals = vals.at[J].set(jnp.zeros(W).at[jnp.clip(I - oJ, 0, W - 1)].set(final))
+    vals = vals.at[J].set(jnp.zeros(W).at[I % W].set(final))
     return BandedMatrix(vals, offsets, log_scales)
 
 
@@ -297,12 +361,12 @@ def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
         prev_vals, prev_off = carry  # column j+1 of beta (or seed when j+1==J)
         # Splice in the seed column when we reach the last interior column.
         at_seed = j == J - 1
-        seed_col = seed.at[jnp.clip(I - offsets[J], 0, W - 1)].set(1.0)
+        seed_col = seed.at[I % W].set(1.0)
         prev_vals = jnp.where(at_seed, seed_col, prev_vals)
         prev_off = jnp.where(at_seed, offsets[J], prev_off)
 
         o = offsets[j]
-        rows = o + jnp.arange(W, dtype=jnp.int32)
+        rows = circ_rows(o, W)
         rnext = jnp.take(read_i32, jnp.clip(rows, 0, Imax - 1))  # read[i] = base i+1
         t_next = tpl_i32[jnp.minimum(j, Jmax - 1)]               # base of column j+1
         tr_cur = trans[j - 1]                                    # moves leaving pos j-1
@@ -324,9 +388,11 @@ def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
         b = jnp.where(valid, b, 0.0)
 
         ins = jnp.where(nxt_match, tr_cur[TRANS_BRANCH], tr_cur[TRANS_STICK] / 3.0)
-        c = jnp.where(valid & (rows < I - 1), ins, 0.0)
+        # rows < o + W - 1 cuts the reverse circular scan at the band's
+        # last row (its in-column successor is out of band)
+        c = jnp.where(valid & (rows < I - 1) & (rows < o + W - 1), ins, 0.0)
 
-        col = _affine_scan(b, c, reverse=True)
+        col = _affine_scan_circ(b, c, reverse=True)
 
         active = (j >= 1) & (j < J)
         cmax = jnp.max(col)
@@ -347,7 +413,7 @@ def banded_backward(read, read_len, tpl, trans, tpl_len, width: int,
 
     # Column J seed, then column 0 terminal from the *assembled* column 1
     # (for J == 1 column 1 is the seed itself).
-    seedJ = jnp.zeros(W, jnp.float32).at[jnp.clip(I - offsets[J], 0, W - 1)].set(1.0)
+    seedJ = jnp.zeros(W, jnp.float32).at[I % W].set(1.0)
     vals = jnp.concatenate([jnp.zeros((1, W)), cols], axis=0)  # cols 0..Jmax-1
     vals = jnp.concatenate([vals, jnp.zeros((1, W))], axis=0)
     vals = vals.at[J].set(seedJ)

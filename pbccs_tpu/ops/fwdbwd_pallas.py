@@ -5,29 +5,33 @@ pair-HMM recurrence (reference ConsensusCore/src/C++/Arrow/
 SimpleRecursor.cpp:62-296), evaluated as
 
   1. an XLA **coefficient precompute** -- for every (read, column) the three
-     band-coefficient vectors of the column recurrence
+     band-coefficient vectors of the column recurrence in CIRCULAR lane
+     layout (fwdbwd.BandedMatrix: cell (i, j) at lane i mod W):
 
-         col[k] = cm[k] * prev[k + s - 1]      (match enters from (i-1, j-1))
-                + cd[k] * prev[k + s]          (deletion enters from (i, j-1))
-                + cc[k] * col[k - 1]           (insertion enters from (i-1, j))
+         col[L] = cm[L] * roll(prev, 1)[L]     (match enters from (i-1, j-1))
+                + cd[L] * prev[L]              (deletion enters from (i, j-1))
+                + cc[L] * col[L-1 circ]        (insertion enters from (i-1, j))
 
-     where s = offset(j) - offset(j-1) is the band shift between adjacent
-     columns; and
+     with every cross-column band-membership mask folded into cm/cd and the
+     circular scan's cut (the band's first row) into cc; and
 
   2. a **Pallas kernel** that runs the sequential column scan with the band
-     state resident in VMEM: per column one 8-variant band-shift select, the
-     in-column first-order recurrence as a log2(W) Hillis-Steele affine scan,
+     state resident in VMEM: per column one STATIC lane roll, the in-column
+     first-order recurrence as a log2(W) circular Hillis-Steele affine scan,
      and the ScaledMatrix per-column max-rescale
      (reference Matrix/ScaledMatrix-inl.hpp:74-123).  Reads ride the sublane
      axis (RB per block), the band rides the lanes, and the template-column
      grid axis is sequential with the running column carried in VMEM scratch.
+     (The circular layout replaced per-column 8-variant dynamic shift-select
+     chains -- the kernel's dominant VPU op count and the source of the
+     Mosaic compile blowup at long-template column counts.)
 
-The backward (beta) fill reuses the *same* kernel: reversing the band lanes
-turns the backward in-column recurrence (row i depends on row i+1) into the
-forward scan, and iterating kernel columns as the *static* map j = Jmax - cc
-keeps every index computable with static slices.  The per-read seed column
-(j = J) is injected by the kernel via a seed-column select, and the output
-index map statically reverses columns so no per-read re-assembly is needed.
+The backward (beta) fill reuses the *same* kernel in backward mode (rolls
+and scan run the other circular direction), iterating kernel columns as the
+*static* map j = Jmax - cc so every index is computable with static slices.
+The per-read seed column (j = J) is injected by the kernel via a
+seed-column select, and the output index map statically reverses columns so
+no per-read re-assembly is needed.
 
 TPU lowering notes (all load-bearing, each worth ~10-100x on v5e):
   * every precompute lookup is a static pad/slice or a vmapped
@@ -61,7 +65,9 @@ from pbccs_tpu.models.arrow.params import (
     TRANS_STICK,
     MISMATCH_PROBABILITY,
 )
-from pbccs_tpu.ops.fwdbwd import MAX_BAND_ADVANCE, BandedMatrix, band_offsets
+from pbccs_tpu.ops.fwdbwd import (MAX_BAND_ADVANCE, BandedMatrix,
+                                  band_offsets, circ_roll, circ_rows,
+                                  in_band)
 
 _TINY = 1e-30
 # band may advance at most this many rows per column; single source of
@@ -143,12 +149,38 @@ def window_rows(x, starts, W: int, exact: bool = False):
 _window_rows = window_rows  # internal alias used by the coefficient builders
 
 
+def window_rows_circ(x, starts, W: int, exact: bool = False):
+    """y[j, L] = x[circ_rows(starts[j], W)[L]] — the circular-lane form of
+    window_rows.  The circular window [o, o+W) splits at the lane wrap
+    into two CONTIGUOUS windows (base b = o - o%W and b + W), so it costs
+    two one-hot matmuls + one select — no per-lane gathers."""
+    starts = starts.astype(jnp.int32)
+    q = starts % W
+    b = starts - q
+    win1 = window_rows(x, b, W, exact)
+    win2 = window_rows(x, b + W, W, exact)
+    L = jnp.arange(W, dtype=jnp.int32)
+    return jnp.where(L[None, :] >= q[:, None], win1, win2)
+
+
+# shared circular-layout helpers (single source of truth in ops.fwdbwd)
+_circ_rows_cols = circ_rows
+_in_band2 = in_band
+
+
 def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
-    """Per-column band coefficients of the alpha recurrence for one read.
+    """Per-column circular-lane band coefficients of the alpha recurrence
+    for one read.
 
     read: (Imax,) int32; tpl: (Jmax,) int32; trans: (Jmax, 4) f32;
     offsets: (nc,) int32 band offsets.  Returns (cm, cd, cc) each (nc, W),
-    shifts (nc,) int32, rescale mask (nc,) f32, seed (W,) f32, seedcol int32.
+    rescale mask (nc,) f32, seed (W,) f32, seedcol int32.
+
+    Circular layout: lane L of column j holds row circ_rows(o(j))[L], so
+    the kernel reads the previous column with ONE static lane roll; the
+    cross-column band-membership masks (is row-1 / row inside column
+    j-1's band?) are folded into cm / cd here, and the in-column scan's
+    circular cut (row == o(j) has no in-band predecessor) into cc.
     Mirrors the JAX step in fwdbwd.banded_forward column for column.
     """
     Imax = read.shape[0]
@@ -157,23 +189,12 @@ def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
     hit, miss = 1.0 - eps, eps / 3.0
 
     j = jnp.arange(nc, dtype=jnp.int32)[:, None]            # (nc, 1)
-    k = jnp.arange(W, dtype=jnp.int32)[None, :]
     o = offsets[:, None]
-    om1 = _edge_clip_rows(offsets, 1, nc)[:, None]
-    raw_shifts = (o - om1)[:, 0]
-    shifts = jnp.clip(raw_shifts, 0, _MAX_SHIFT)
-    shifts = jnp.where(jnp.arange(nc) == 0, 0, shifts)
-    # a band advancing >_MAX_SHIFT rows/column (read >~8x its window) cannot
-    # be represented by the kernel's shift-variant select; drop the read
-    # deterministically by zeroing the pinned final cell so LL -> -inf and
-    # the alpha/beta mating gate rejects it (same "drop or re-bucket"
-    # semantics as the reference's AlphaBetaMismatchException,
-    # SimpleRecursor.cpp:683-688).
-    overflow = jnp.any(raw_shifts[1:] > _MAX_SHIFT)
+    om1 = _edge_clip_rows(offsets, 1, nc)[:, None]          # offset of col j-1
 
-    rows = o + k                                            # (nc, W)
-    read_pad = jnp.concatenate([read[0:1], read])           # [o+k] = read[o+k-1]
-    rbase = _window_rows(read_pad, offsets, W)
+    rows = _circ_rows_cols(offsets, W)                      # (nc, W)
+    read_pad = jnp.concatenate([read[0:1], read])           # [row] = read[row-1]
+    rbase = window_rows_circ(read_pad, offsets, W)
     t_cur = _edge_clip_rows(tpl, 1, nc)[:, None]
     t_next = _edge_clip_rows(tpl, 0, nc)[:, None]
     tr_prev = _edge_clip_rows(trans, 2, nc)                 # (nc, 4)
@@ -186,12 +207,13 @@ def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
         jnp.where(rows == 1, 1.0, 0.0),
         jnp.where(rows == 1, 0.0, tr_prev[:, TRANS_MATCH][:, None]),
     )
-    cm = jnp.where(valid, em * mfac, 0.0)
-    cd = jnp.where(valid & (j > 1), tr_prev[:, TRANS_DARK][:, None], 0.0)
+    cm = jnp.where(valid & _in_band2(rows - 1, om1, W), em * mfac, 0.0)
+    cd = jnp.where(valid & (j > 1) & _in_band2(rows, om1, W),
+                   tr_prev[:, TRANS_DARK][:, None], 0.0)
     ins = jnp.where(rbase == t_next,
                     tr_cur[:, TRANS_BRANCH][:, None],
                     tr_cur[:, TRANS_STICK][:, None] / 3.0)
-    cc = jnp.where(valid & (rows > 1), ins, 0.0)
+    cc = jnp.where(valid & (rows > 1) & (rows > o), ins, 0.0)
 
     # final pinned column j == J: alpha(I, J) = alpha(I-1, J-1) * em_last
     # (SimpleRecursor.cpp:171-180)
@@ -199,7 +221,9 @@ def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
         read[jnp.clip(I - 1, 0, Imax - 1)] == tpl[jnp.clip(J - 1, 0, Jmax - 1)],
         hit, miss)
     pinned = j == J
-    cm = jnp.where(pinned, jnp.where(rows == I, jnp.where(overflow, 0.0, em_last), 0.0), cm)
+    cm = jnp.where(pinned,
+                   jnp.where((rows == I) & _in_band2(rows - 1, om1, W),
+                             em_last, 0.0), cm)
     cd = jnp.where(pinned, 0.0, cd)
     cc = jnp.where(pinned, 0.0, cc)
 
@@ -210,15 +234,15 @@ def _forward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
 
     mask = ((j[:, 0] >= 1) & (j[:, 0] < J)).astype(jnp.float32)
     seed = (jnp.arange(W) == 0).astype(jnp.float32)
-    return cm, cd, cc, shifts, mask, seed, jnp.int32(0)
+    return cm, cd, cc, mask, seed, jnp.int32(0)
 
 
 def _backward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
-    """Beta coefficients in the static kernel frame: kernel column cc holds
-    beta column j = Jmax - cc with lanes reversed
-    (kk = W-1 - (i - offset(j))).  The kernel's output index map reverses
-    columns, so kernel column cc lands at output column nc-1-cc, i.e. beta
-    column j sits at output column j + (nc-1-Jmax).
+    """Beta coefficients: kernel column cc holds beta column j = Jmax - cc
+    in the SAME circular lane layout as alpha (lane L = row r === L mod W;
+    no lane reversal -- the kernel's backward mode rolls the other way).
+    The kernel's output index map reverses columns, so beta column j sits
+    at output column j + (nc-1-Jmax).
 
     Mirrors the JAX step in fwdbwd.banded_backward column for column."""
     Imax = read.shape[0]
@@ -226,18 +250,15 @@ def _backward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
     nc = offsets.shape[0]
     hit, miss = 1.0 - eps, eps / 3.0
 
-    k = jnp.arange(W, dtype=jnp.int32)[None, :]
     cc_idx = jnp.arange(nc, dtype=jnp.int32)[:, None]
     j = Jmax - cc_idx                                       # beta column (static)
-    o_j = _rev_clip_rows(offsets, Jmax, nc)[:, None]
-    o_j1 = _rev_clip_rows(offsets, Jmax + 1, nc)[:, None]
-    raw_shifts = (o_j1 - o_j)[:, 0]
-    shifts = jnp.clip(raw_shifts, 0, _MAX_SHIFT)
-    overflow = jnp.any(raw_shifts > _MAX_SHIFT)  # see _forward_coeffs
+    o_j = _rev_clip_rows(offsets, Jmax, nc)
+    o_j1 = _rev_clip_rows(offsets, Jmax + 1, nc)[:, None]   # offset of col j+1
 
-    rows = o_j + (W - 1 - k)                                # row i at lane kk
+    rows = _circ_rows_cols(o_j, W)                          # (nc, W)
+    o_j = o_j[:, None]
     read_pad = jnp.concatenate([read, read[Imax - 1:]])
-    rnext = _window_rows(read_pad, o_j[:, 0], W)[:, ::-1]   # read base i+1
+    rnext = window_rows_circ(read_pad, o_j[:, 0], W)        # read base i+1
     t_next = _rev_clip_rows(tpl, Jmax, nc)[:, None]         # base of col j+1
     tr_cur = _rev_clip_rows(trans, Jmax - 1, nc)            # moves leaving j-1
 
@@ -249,18 +270,21 @@ def _backward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
         tr_cur[:, TRANS_MATCH][:, None],
         jnp.where((rows == I - 1) & (j == J - 1), 1.0, 0.0),
     )
-    cm = jnp.where(valid, em * mfac, 0.0)
-    cd = jnp.where(valid & (j >= 1) & (j < J - 1),
+    cm = jnp.where(valid & _in_band2(rows + 1, o_j1, W), em * mfac, 0.0)
+    cd = jnp.where(valid & (j >= 1) & (j < J - 1) & _in_band2(rows, o_j1, W),
                    tr_cur[:, TRANS_DARK][:, None], 0.0)
     ins = jnp.where(nxt_match,
                     tr_cur[:, TRANS_BRANCH][:, None],
                     tr_cur[:, TRANS_STICK][:, None] / 3.0)
-    cc = jnp.where(valid & (rows < I - 1), ins, 0.0)
+    # rows < o + W - 1 cuts the reverse circular scan at the band's top row
+    cc = jnp.where(valid & (rows < I - 1) & (rows < o_j + W - 1), ins, 0.0)
 
     # terminal beta column j == 0: beta(0,0) = beta(1,1) * em(read[0], tpl[0])
-    em0 = jnp.where(overflow, 0.0, jnp.where(read[0] == tpl[0], hit, miss))
+    em0 = jnp.where(read[0] == tpl[0], hit, miss)
     at0 = j == 0
-    cm = jnp.where(at0, jnp.where(k == W - 1, em0, 0.0), cm)
+    cm = jnp.where(at0,
+                   jnp.where((rows == 0) & _in_band2(rows + 1, o_j1, W),
+                             em0, 0.0), cm)
     cd = jnp.where(at0, 0.0, cd)
     cc = jnp.where(at0, 0.0, cc)
 
@@ -270,10 +294,8 @@ def _backward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
     cc = jnp.where(dead, 0.0, cc)
 
     mask = ((j[:, 0] >= 1) & (j[:, 0] <= J - 1)).astype(jnp.float32)
-    oJ = jnp.take(offsets, jnp.clip(J, 0, nc - 1))
-    seed_lane = W - 1 - (I - oJ)
-    seed = (jnp.arange(W) == jnp.clip(seed_lane, 0, W - 1)).astype(jnp.float32)
-    return cm, cd, cc, shifts, mask, seed, (Jmax - J).astype(jnp.int32)
+    seed = (jnp.arange(W) == I % W).astype(jnp.float32)
+    return cm, cd, cc, mask, seed, (Jmax - J).astype(jnp.int32)
 
 
 # --------------------------------------------------------------------------
@@ -281,27 +303,24 @@ def _backward_coeffs(read, I, tpl, trans, J, offsets, W: int, eps: float):
 # --------------------------------------------------------------------------
 
 
-def _shift_left(x, t: int):
-    """y[k] = x[k+t] (zeros outside); t may be negative."""
-    if t == 0:
-        return x
-    z = jnp.zeros((x.shape[0], abs(t)), x.dtype)
-    if t > 0:
-        return jnp.concatenate([x[:, t:], z], axis=1)
-    return jnp.concatenate([z, x[:, :t]], axis=1)
+_roll_lanes = circ_roll    # Mosaic-friendly: two static slices + concat
 
 
-def _shift_right_fill(x, d: int, fill: float):
-    """y[k] = x[k-d] for k >= d else `fill`."""
-    f = jnp.full((x.shape[0], d), fill, x.dtype)
-    return jnp.concatenate([f, x[:, :-d]], axis=1)
+def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool,
+                 backward: bool):
+    """Column scan over circular-lane bands.  Arrays are in kernel layout
+    (columns, R, W): the column axis is the *leading* (untiled) dimension,
+    so the per-column dynamic index is plain VMEM address arithmetic.
+    (Dynamic indexing on the sublane axis of an (R, columns, W) layout
+    measured ~20x slower on v5e.)
 
-
-def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
-    """Column scan.  Arrays are in kernel layout (columns, R, W): the column
-    axis is the *leading* (untiled) dimension, so the per-column dynamic
-    index is plain VMEM address arithmetic.  (Dynamic indexing on the sublane
-    axis of an (R, columns, W) layout measured ~20x slower on v5e.)
+    Circular lanes (fwdbwd.BandedMatrix): cell (i, j) lives at lane
+    i mod W whatever the column offset, so the cross-column operand is ONE
+    static lane roll -- the per-column 8-variant (15 for Merge) dynamic
+    shift-select chains this replaced were the kernel's dominant VPU op
+    count and the Mosaic compile blowup at long templates.  All band-
+    membership masks are folded into cm/cd/cg and the scan cut into cc by
+    the XLA precompute, so the kernel body is pure fma + roll + scan.
 
     The seed column is injected into b BEFORE the in-column scan: for the
     Arrow fills the seed columns have zero in-column coefficients so this
@@ -309,53 +328,34 @@ def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
     fills, whose seed columns chain the Extra move through the scan
     (alpha column 0; beta column J below the pin).
 
-    With merge=True (the Quiver recurrence) two extra inputs (shifts2, cg)
-    and two extra scratch slots (prev2, its scale) carry the j-2 Merge
-    operand: b += cg[k] * prev2[k + s2 - 1] / scale_prev
+    With merge=True (the Quiver recurrence) one extra input (cg) and two
+    extra scratch slots (prev2, its scale) carry the j-2 Merge operand:
+    b += cg[L] * roll(prev2)[L] / scale_prev
     (Quiver/SimpleRecursor.cpp merge move; models/quiver/recursor.py)."""
     if merge:
-        (seed_ref, seedcol_ref, shifts_ref, mask_ref, cm_ref, cd_ref,
-         cc_ref, sh2_ref, cg_ref, vals_ref, ls_ref, prev_ref, prev2_ref,
+        (seed_ref, seedcol_ref, mask_ref, cm_ref, cd_ref,
+         cc_ref, cg_ref, vals_ref, ls_ref, prev_ref, prev2_ref,
          sprev_ref) = refs
     else:
-        (seed_ref, seedcol_ref, shifts_ref, mask_ref, cm_ref, cd_ref,
+        (seed_ref, seedcol_ref, mask_ref, cm_ref, cd_ref,
          cc_ref, vals_ref, ls_ref, prev_ref) = refs
     jb = pl.program_id(1)
     seed = seed_ref[...]
     seedcol = seedcol_ref[...]                              # (RB, 1) int32
     RB, W = seed.shape
-    # the Merge variant's extra 15-way shift select per column makes the
-    # 4-column unroll pathologically slow to compile on Mosaic (observed
-    # minutes-to-never at tiny shapes); run it column at a time
-    u = 1 if merge else _UNROLL
+    u = _UNROLL
+    t = -1 if backward else 1   # roll direction: row i-1 fwd / i+1 bwd
 
-    def one_col(prev, prev2, sprev, jglob, s, cm, cd, cco, m, s2, cg):
-        # band-shift selects: vsm1[k] = prev[k + s - 1], vs[k] = prev[k + s].
-        # vs needs its OWN select: deriving it as vsm1 shifted left by one
-        # zeroes the last lane (vs[W-1] = vsm1[W] = 0 instead of
-        # prev[W-1 + s]), dropping a real in-band contribution whenever
-        # s == 0 -- negligible at the Arrow band edge but a visible error
-        # at the Quiver backward corner (row 0 rides lane W-1).
-        vsm1 = jnp.zeros((RB, W), jnp.float32)
-        vs = jnp.zeros((RB, W), jnp.float32)
-        for t in range(-1, _MAX_SHIFT):
-            vt = _shift_left(prev, t)
-            vsm1 = jnp.where(s - 1 == t, vt, vsm1)
-            vs = jnp.where(s - 1 == t, _shift_left(prev, t + 1), vs)
-
-        b = cm * vsm1 + cd * vs
+    def one_col(prev, prev2, sprev, jglob, cm, cd, cco, m, cg):
+        b = cm * _roll_lanes(prev, t) + cd * prev
         if merge:
-            vgm1 = jnp.zeros((RB, W), jnp.float32)
-            for t in range(-1, 2 * _MAX_SHIFT):
-                vt = _shift_left(prev2, t)
-                vgm1 = jnp.where(s2 - 1 == t, vt, vgm1)
-            b = b + cg * (vgm1 / sprev)
+            b = b + cg * (_roll_lanes(prev2, t) / sprev)
         b = jnp.where(seedcol == jglob, b + seed, b)
         c = cco
         d = 1
-        while d < W:                                        # affine prefix scan
-            b = b + c * _shift_right_fill(b, d, 0.0)
-            c = c * _shift_right_fill(c, d, 1.0)
+        while d < W:                # circular affine prefix scan (cut in c)
+            b = b + c * _roll_lanes(b, t * d)
+            c = c * _roll_lanes(c, t * d)
             d *= 2
 
         col = b
@@ -376,9 +376,7 @@ def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
             prev2 = jnp.where(first, jnp.zeros_like(prev), prev2_ref[...])
             sprev = jnp.where(first, jnp.ones((RB, 1), jnp.float32),
                               sprev_ref[...])
-            s2_c = sh2_ref[pl.dslice(base, u)]
             cg_c = cg_ref[pl.dslice(base, u)]
-        s_c = shifts_ref[pl.dslice(base, u)]                # (u, RB, 1)
         cm_c = cm_ref[pl.dslice(base, u)]                   # (u, RB, W)
         cd_c = cd_ref[pl.dslice(base, u)]
         cc_c = cc_ref[pl.dslice(base, u)]
@@ -389,9 +387,9 @@ def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
             jglob = jb * jb_size + base + k
             col, ls, scale = one_col(
                 prev, prev2 if merge else None,
-                sprev if merge else None, jglob, s_c[k], cm_c[k],
+                sprev if merge else None, jglob, cm_c[k],
                 cd_c[k], cc_c[k], m_c[k] > 0,
-                s2_c[k] if merge else None, cg_c[k] if merge else None)
+                cg_c[k] if merge else None)
             cols.append(col)
             lss.append(ls)
             if merge:
@@ -414,20 +412,20 @@ def _fill_kernel(*refs, jb_size: int, rev_store: bool, merge: bool):
     lax.fori_loop(0, jb_size // u, body, 0)
 
 
-def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool,
-              shifts2=None, cg=None):
+def _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store: bool,
+              cg=None, backward: bool | None = None):
     """Invoke the column-scan kernel.
 
-    cm/cd/cc: (R, nc, W); shifts/mask: (R, nc); seed: (R, W); seedcol: (R,).
+    cm/cd/cc: (R, nc, W); mask: (R, nc); seed: (R, W); seedcol: (R,).
     Returns vals (R, nc, W) and log-scales (R, nc).  With rev_store, output
-    column t holds kernel column nc-1-t.  Passing shifts2+cg engages the
-    Merge carry (Quiver recurrence)."""
+    column t holds kernel column nc-1-t.  Passing cg engages the Merge
+    carry (Quiver recurrence).  backward sets the kernel's roll/scan
+    direction (defaults to rev_store)."""
     R, nc, W = cm.shape
     merge = cg is not None
+    backward = rev_store if backward is None else backward
     # the Merge carry (Quiver) doubles the live column state (prev2 + its
-    # scale, the 2*MAX_SHIFT select chain): at the full 32-read block its
-    # scoped VMEM tops 16 MB on v5e (observed 18.05M OOM at nc=192,
-    # R=512), so merge fills run half-width read blocks
+    # scale), so merge fills run half-width read blocks for VMEM headroom
     rb = min(_RB // 2 if merge else _RB, R)
     jb = min(_JB, nc)
     assert nc % jb == 0 and R % rb == 0
@@ -437,11 +435,10 @@ def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool,
     cm_k = jnp.transpose(cm, (1, 0, 2))
     cd_k = jnp.transpose(cd, (1, 0, 2))
     cc_k = jnp.transpose(cc, (1, 0, 2))
-    sh_k = jnp.transpose(shifts)[:, :, None]
     mk_k = jnp.transpose(mask)[:, :, None]
 
     kernel = functools.partial(_fill_kernel, jb_size=jb, rev_store=rev_store,
-                               merge=merge)
+                               merge=merge, backward=backward)
     if rev_store:
         col_spec = pl.BlockSpec((jb, rb, W), lambda r, j: (njb - 1 - j, r, 0))
         vec_ospec = pl.BlockSpec((jb, rb, 1), lambda r, j: (njb - 1 - j, r, 0))
@@ -453,16 +450,14 @@ def _run_fill(cm, cd, cc, shifts, mask, seed, seedcol, rev_store: bool,
     in_specs = [
         pl.BlockSpec((rb, W), lambda r, j: (r, 0)),     # seed
         pl.BlockSpec((rb, 1), lambda r, j: (r, 0)),     # seedcol
-        in_vec,                                          # shifts
         in_vec,                                          # mask
         in_col, in_col, in_col,                          # cm, cd, cc
     ]
-    operands = [seed, seedcol[:, None], sh_k, mk_k, cm_k, cd_k, cc_k]
+    operands = [seed, seedcol[:, None], mk_k, cm_k, cd_k, cc_k]
     scratch = [pltpu.VMEM((rb, W), jnp.float32)]
     if merge:
-        in_specs += [in_vec, in_col]                     # shifts2, cg
-        operands += [jnp.transpose(shifts2)[:, :, None],
-                     jnp.transpose(cg, (1, 0, 2))]
+        in_specs += [in_col]                             # cg
+        operands += [jnp.transpose(cg, (1, 0, 2))]
         scratch += [pltpu.VMEM((rb, W), jnp.float32),    # prev2
                     pltpu.VMEM((rb, 1), jnp.float32)]    # its scale
     vals, ls = pl.pallas_call(
@@ -488,8 +483,7 @@ def _pad_cols(n: int) -> int:
 
 def _resolve_offsets(offsets, I, J, nc: int, width: int):
     """Diagonal offsets unless precomputed ones are supplied; pads supplied
-    offsets to nc columns by repeating the last value (slope 0, so the
-    kernel's shift/overflow math never trips on padding columns)."""
+    offsets to nc columns by repeating the last value (slope 0 padding)."""
     if offsets is None:
         return jax.vmap(lambda i, jl: band_offsets(i, jl, nc, width))(I, J)
     offsets = jnp.asarray(offsets, jnp.int32)
@@ -526,7 +520,9 @@ def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
 
     offsets: optional (R, >= Jmax+1) precomputed band offsets (guided
     rebanding, fwdbwd.guided_band_offsets); default diagonal layout.
-    Must be monotone with per-column advance <= _MAX_SHIFT."""
+    Must be monotone (any per-column advance is representable in the
+    circular lane layout; columns whose bands do not overlap simply
+    carry no mass)."""
     R, Imax = reads.shape
     Jmax = tpls.shape[1]
     nc = _pad_cols(Jmax + 1)
@@ -535,16 +531,15 @@ def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
     I = rlens.astype(jnp.int32)
     J = tlens.astype(jnp.int32)
     offsets = _resolve_offsets(offsets, I, J, nc, width)
-    cm, cd, cc, shifts, mask, seed, seedcol = jax.vmap(
+    cm, cd, cc, mask, seed, seedcol = jax.vmap(
         lambda r, i, t, tr, jl, o: _forward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,
             width, pr_miscall),
     )(reads, I, tpls, trans, J, offsets)
 
-    cm, cd, cc, shifts, mask, seed, seedcol = _pad_r(
-        [cm, cd, cc, shifts, mask, seed, seedcol], R, Rp)
-    vals, ls = _run_fill(cm, cd, cc, shifts, mask, seed, seedcol,
-                         rev_store=False)
+    cm, cd, cc, mask, seed, seedcol = _pad_r(
+        [cm, cd, cc, mask, seed, seedcol], R, Rp)
+    vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store=False)
     return BandedMatrix(vals[:R, : Jmax + 1], offsets[:, : Jmax + 1],
                         ls[:R, : Jmax + 1])
 
@@ -562,21 +557,20 @@ def pallas_backward_batch(reads, rlens, tpls, trans, tlens, width: int,
     I = rlens.astype(jnp.int32)
     J = tlens.astype(jnp.int32)
     offsets = _resolve_offsets(offsets, I, J, nc, width)
-    cm, cd, cc, shifts, mask, seed, seedcol = jax.vmap(
+    cm, cd, cc, mask, seed, seedcol = jax.vmap(
         lambda r, i, t, tr, jl, o: _backward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,
             width, pr_miscall),
     )(reads, I, tpls, trans, J, offsets)
 
-    cm, cd, cc, shifts, mask, seed, seedcol = _pad_r(
-        [cm, cd, cc, shifts, mask, seed, seedcol], R, Rp)
-    vals, ls = _run_fill(cm, cd, cc, shifts, mask, seed, seedcol,
-                         rev_store=True)
+    cm, cd, cc, mask, seed, seedcol = _pad_r(
+        [cm, cd, cc, mask, seed, seedcol], R, Rp)
+    vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store=True)
     # with rev_store, output column t = kernel col nc-1-t = beta col
     # Jmax - (nc-1-t) => beta col j sits at t = j + (nc-1-Jmax); lanes are
-    # stored kernel-flipped, so un-flip them here (static reverse).
+    # already in the shared circular layout (no kernel-frame flip).
     lo = nc - 1 - Jmax
-    vals = vals[:R, lo: lo + Jmax + 1, ::-1]
+    vals = vals[:R, lo: lo + Jmax + 1]
     ls = ls[:R, lo: lo + Jmax + 1]
     return BandedMatrix(vals, offsets[:, : Jmax + 1], ls)
 

@@ -37,8 +37,10 @@ from pbccs_tpu.models.arrow.params import (
     MISMATCH_PROBABILITY,
     context_index,
 )
-from pbccs_tpu.ops.fwdbwd import BandedMatrix, _affine_scan, _gather_band, banded_forward, forward_loglik
-from pbccs_tpu.ops.fwdbwd_pallas import window_rows
+from pbccs_tpu.ops.fwdbwd import (BandedMatrix, _affine_scan_circ,
+                                  _gather_band, banded_forward, circ_roll,
+                                  circ_rows, forward_loglik, in_band)
+from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
 
 SUB, INS, DEL = 0, 1, 2
 _TINY = 1e-30
@@ -163,7 +165,7 @@ def extend_link_score(read, read_len, win_tpl, win_trans, win_len,
     def fill_col(prev_vals, prev_off, j):
         """One ExtendAlpha column at virtual DP column j (template pos j-1)."""
         o = alpha.offsets[jnp.clip(j, 0, alpha.offsets.shape[0] - 1)]
-        rows = o + jnp.arange(W, dtype=jnp.int32)
+        rows = circ_rows(o, W)
         rbase = jnp.take(read, jnp.clip(rows - 1, 0, Imax - 1))
         cur_b = vb(j - 1)
         prev_tr = vt(j - 2)
@@ -185,8 +187,9 @@ def extend_link_score(read, read_len, win_tpl, win_trans, win_len,
         b = jnp.where(in_read, b, 0.0)
 
         ins_em = jnp.where(rbase == next_b, cur_tr[TRANS_BRANCH], cur_tr[TRANS_STICK] / 3.0)
-        c = jnp.where(in_read & (rows > 1) & (rows < max_down) & (j != max_left), ins_em, 0.0)
-        return _affine_scan(b, c), o
+        c = jnp.where(in_read & (rows > 1) & (rows < max_down)
+                      & (j != max_left) & (rows > o), ins_em, 0.0)
+        return _affine_scan_circ(b, c), o
 
     a_prev = alpha.vals[jnp.clip(s - 1, 0, alpha.vals.shape[0] - 1)]
     a_prev_off = alpha.offsets[jnp.clip(s - 1, 0, alpha.offsets.shape[0] - 1)]
@@ -195,7 +198,7 @@ def extend_link_score(read, read_len, win_tpl, win_trans, win_len,
 
     # LinkAlphaBeta (SimpleRecursor.cpp:306-357): stitch ext1 (virtual column
     # s+1 = absolute link col - 1) to beta columns beta_link_col / +1.
-    rows = o1 + jnp.arange(W, dtype=jnp.int32)          # row ids i
+    rows = circ_rows(o1, W)                             # row ids i
     link_tr = vt(abs_col - 2)
     link_b = vb(abs_col - 1)
     rbase_next = jnp.take(read, jnp.clip(rows, 0, Imax - 1))  # read base i+1
@@ -276,20 +279,6 @@ def _shift_last(x, t: int):
     if t > 0:
         return jnp.pad(x[..., t:], pad + [(0, t)])
     return jnp.pad(x[..., :t], pad + [(-t, 0)])
-
-
-def _select_shift(x, d, dmin: int, dmax: int):
-    """y[m, k] = x[m, k + d[m]] for per-row dynamic d in [dmin, dmax]
-    (zeros outside the band); single-level static-shift select.
-
-    NOTE composing two zero-fill shifts is NOT a zero-fill shift of the sum
-    (intermediate shifts clip edge lanes), so each variant must be one
-    direct static shift."""
-    r = jnp.clip(d, dmin, dmax)
-    out = jnp.zeros_like(x)
-    for t in range(dmin, dmax + 1):
-        out = jnp.where(r[..., None] == t, _shift_last(x, t), out)
-    return out
 
 
 def _row_select(idx, src):
@@ -373,24 +362,38 @@ def _virtual_lookup(win_tpl, win_trans, p, patch_bases, patch_trans,
     return vb, vt
 
 
-def _ext_col(prev_vals, d, o_col, rbase_row, jcol, cur_b, next_b,
+def _circ_rows_batch(o, W: int):
+    """(M, W) absolute rows of each circular lane for per-row offsets o."""
+    return circ_rows(o, W)
+
+
+def _in_band(rows, o, W: int):
+    """(M, W) mask: row in the band [o, o+W) of a column with offset o."""
+    return in_band(rows, o[:, None], W)
+
+
+def _ext_col(prev_vals, o_prev, o_col, rbase_row, jcol, cur_b, next_b,
              prev_tr, cur_tr, *, I, max_left, hit, em_miss, W):
     """One batched virtual-template DP column (the ExtendAlpha column fill of
     the gather-free scorers): solves the within-column insertion recurrence
     over the band for every mutation row at virtual DP column `jcol`.
 
-    prev_vals: (M, W) previous virtual column; d: (M,) band-offset delta
-    o_col - o_prev; o_col: (M,) band offset of this column; rbase_row /
-    cur_b / next_b / prev_tr / cur_tr: per-mutation read/template context.
-    Handles the j == 1 start column (reachable only by the pinned initial
-    match, reference SimpleRecursor.cpp:119-141) and the pinned (I, J)
-    corner."""
-    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
-    rows = o_col[:, None] + karange
+    prev_vals: (M, W) previous virtual column in circular lane layout;
+    o_prev / o_col: (M,) band offsets of the previous / this column;
+    rbase_row / cur_b / next_b / prev_tr / cur_tr: per-mutation
+    read/template context.  Handles the j == 1 start column (reachable
+    only by the pinned initial match, reference SimpleRecursor.cpp:
+    119-141) and the pinned (I, J) corner.
+
+    Circular layout makes the cross-column band alignment a static lane
+    roll + in-band mask for ANY offset delta -- the bounded shift-variant
+    selects this replaced capped the delta at 7 rows/column."""
+    rows = _circ_rows_batch(o_col, W)
     in_read = (rows >= 1) & (rows <= I)
     em = jnp.where(rbase_row == cur_b[:, None], hit, em_miss)
-    pm1 = _select_shift(prev_vals, d - 1, -1, 7)
-    p0 = _select_shift(prev_vals, d, 0, 7)
+    pm1 = jnp.where(_in_band(rows - 1, o_prev, W),
+                    circ_roll(prev_vals, 1), 0.0)
+    p0 = jnp.where(_in_band(rows, o_prev, W), prev_vals, 0.0)
 
     generic = (rows < I) & (jcol < max_left)[:, None]
     pinned = (rows == I) & (jcol == max_left)[:, None]
@@ -408,8 +411,9 @@ def _ext_col(prev_vals, d, o_col, rbase_row, jcol, cur_b, next_b,
                        cur_tr[:, TRANS_BRANCH][:, None],
                        cur_tr[:, TRANS_STICK][:, None] / 3.0)
     c = jnp.where(in_read & (rows > 1) & (rows < I)
-                  & (jcol != max_left)[:, None], ins_em, 0.0)
-    return _affine_scan(b, c)
+                  & (jcol != max_left)[:, None]
+                  & (rows > o_col[:, None]), ins_em, 0.0)
+    return _affine_scan_circ(b, c)
 
 
 def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
@@ -438,13 +442,13 @@ def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
     blc = 1 + mend                       # beta link column
     abs_col = blc + ld
 
-    # ---- read windows per column (MXU im2col) --------------------------
+    # ---- read windows per column (MXU im2col, circular lanes) ----------
     read_f = read.astype(jnp.float32)
     offs = alpha.offsets
     # base codes 0..4 are bf16-exact, so the fast bf16 matmul path is safe
-    rnext_win = window_rows(read_f, offs, W)                 # read[o_j + k]
-    rbase_win = window_rows(
-        jnp.concatenate([read_f[0:1], read_f]), offs, W)     # read[o_j + k - 1]
+    rnext_win = window_rows_circ(read_f, offs, W)            # read[row(L)]
+    rbase_win = window_rows_circ(
+        jnp.concatenate([read_f[0:1], read_f]), offs, W)     # read[row(L)-1]
 
     # ---- per-mutation row-selects (one matmul per index array) ---------
     offs_f = offs.astype(jnp.float32)[:, None]
@@ -470,24 +474,23 @@ def interior_scores_fast(read, read_len, win_tpl, win_trans, win_len,
                              patch_shift, _NB_INTERIOR)
     one_col = functools.partial(_ext_col, I=I, max_left=max_left,
                                 hit=hit, em_miss=em_miss, W=W)
-    karange = jnp.arange(W, dtype=jnp.int32)[None, :]
 
     c_sm1 = s - 1 - p
     c_s = s - p
     c_s1 = s + 1 - p
-    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s,
+    ext0 = one_col(A_prev, o_sm1, o_s, rb_s, s,
                    vb(c_sm1), vb(c_s), vt(c_sm1 - 1), vt(c_sm1))
-    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s + 1,
+    ext1 = one_col(ext0, o_s, o_s1, rb_s1, s + 1,
                    vb(c_s), vb(c_s1), vt(c_s - 1), vt(c_s))
 
     # LinkAlphaBeta
-    rows = o_s1[:, None] + karange
+    rows = _circ_rows_batch(o_s1, W)
     link_tr = vt(abs_col - 2 - p)
     link_b = vb(abs_col - 1 - p)
     em_link = jnp.where(rn_s1 == link_b[:, None], hit, em_miss)
-    d_b = o_s1 - o_b
-    beta_ip1 = _select_shift(B_col, d_b + 1, -20, 1)
-    beta_i = _select_shift(B_col, d_b, -21, 0)
+    beta_ip1 = jnp.where(_in_band(rows + 1, o_b, W),
+                         circ_roll(B_col, -1), 0.0)
+    beta_i = jnp.where(_in_band(rows, o_b, W), B_col, 0.0)
     match_term = jnp.where(rows < I, ext1 * link_tr[:, TRANS_MATCH][:, None]
                            * em_link * beta_ip1, 0.0)
     del_term = ext1 * link_tr[:, TRANS_DARK][:, None] * beta_i
@@ -549,9 +552,9 @@ def edge_scores_fast(read, read_len, win_tpl, win_trans, win_len,
 
     read_f = read.astype(jnp.float32)
     offs = alpha.offsets
-    rnext_win = window_rows(read_f, offs, W)                 # read[o_j + k]
-    rbase_win = window_rows(
-        jnp.concatenate([read_f[0:1], read_f]), offs, W)     # read[o_j + k - 1]
+    rnext_win = window_rows_circ(read_f, offs, W)            # read[row(L)]
+    rbase_win = window_rows_circ(
+        jnp.concatenate([read_f[0:1], read_f]), offs, W)     # read[row(L)-1]
 
     vb, vt = _virtual_lookup(win_tpl, win_trans, p, patch_bases, patch_trans,
                              patch_shift, _NB_EDGE)
@@ -566,7 +569,7 @@ def edge_scores_fast(read, read_len, win_tpl, win_trans, win_len,
     o_prev = jnp.zeros((), jnp.int32)
     for j in range(1, 5):
         o_j = offs[j]
-        ext = one_col(ext, jnp.broadcast_to(o_j - o_prev, (M,)),
+        ext = one_col(ext, jnp.broadcast_to(o_prev, (M,)),
                       jnp.broadcast_to(o_j, (M,)),
                       jnp.broadcast_to(rbase_win[j], (M, W)),
                       jnp.full((M,), j, jnp.int32),
@@ -581,14 +584,14 @@ def edge_scores_fast(read, read_len, win_tpl, win_trans, win_len,
     B_col, o_b = sel_b[:, :W], sel_b[:, W].astype(jnp.int32)
     bsuf_b = sel_b[:, W + 1]
 
-    rows4 = offs[4] + karange
+    rows4 = _circ_rows_batch(jnp.broadcast_to(offs[4], (M,)), W)
     link_tr = vt(3 - p)
     link_b = vb(4 - p)
     em_link = jnp.where(jnp.broadcast_to(rnext_win[4], (M, W)) == link_b[:, None],
                         hit, em_miss)
-    d_b = jnp.broadcast_to(offs[4], (M,)) - o_b
-    beta_ip1 = _select_shift(B_col, d_b + 1, -21, 1)
-    beta_i = _select_shift(B_col, d_b, -22, 0)
+    beta_ip1 = jnp.where(_in_band(rows4 + 1, o_b, W),
+                         circ_roll(B_col, -1), 0.0)
+    beta_i = jnp.where(_in_band(rows4, o_b, W), B_col, 0.0)
     match_term = jnp.where(rows4 < I, ext * link_tr[:, TRANS_MATCH][:, None]
                            * em_link * beta_ip1, 0.0)
     del_term = ext * link_tr[:, TRANS_DARK][:, None] * beta_i
@@ -608,17 +611,18 @@ def edge_scores_fast(read, read_len, win_tpl, win_trans, win_len,
     rb_s2, o_s2 = sel_s2[:, :W], sel_s2[:, W].astype(jnp.int32)
 
     c0 = s - p
-    ext0 = one_col(A_prev, o_s - o_sm1, o_s, rb_s, s,
+    ext0 = one_col(A_prev, o_sm1, o_s, rb_s, s,
                    vb(c0 - 1), vb(c0), vt(c0 - 2), vt(c0 - 1))
-    ext1 = one_col(ext0, o_s1 - o_s, o_s1, rb_s1, s + 1,
+    ext1 = one_col(ext0, o_s, o_s1, rb_s1, s + 1,
                    vb(c0), vb(c0 + 1), vt(c0 - 1), vt(c0))
-    ext2 = one_col(ext1, o_s2 - o_s1, o_s2, rb_s2, s + 2,
+    ext2 = one_col(ext1, o_s1, o_s2, rb_s2, s + 2,
                    vb(c0 + 1), vb(c0 + 2), vt(c0), vt(c0 + 1))
 
     kstar = max_left - s                                     # 1 or 2
     corner_vals = jnp.where((kstar == 1)[:, None], ext1, ext2)
     o_corner = jnp.where(kstar == 1, o_s1, o_s2)
-    corner = jnp.sum(jnp.where(karange == (I - o_corner)[:, None],
+    in_b = ((I >= o_corner) & (I < o_corner + W))[:, None]
+    corner = jnp.sum(jnp.where((karange == (I % W)) & in_b,
                                corner_vals, 0.0), axis=1)
     score_ne = jnp.log(jnp.maximum(corner, _TINY)) + apre_s
 
