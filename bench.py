@@ -268,27 +268,38 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
             "--chunkSize", str(chunk), "--numThreads", "3", "--zmws", "all",
             "--reportFile", os.path.join(tmp, "ccs_report.csv")]
 
+    from pbccs_tpu.runtime import timing
+
     repeats = int(os.environ.get("BENCH_E2E_REPEATS", 3))
     try:
         rc = cli.run(argv)  # warmup + correctness
         assert rc == 0, f"cli.run failed rc={rc}"
-        times = []
+        times, stage_runs = [], []
         for _ in range(repeats):
+            timing.reset()
             t0 = time.monotonic()
             rc = cli.run(argv)
             times.append(time.monotonic() - t0)
+            stage_runs.append(timing.stage_seconds())
             assert rc == 0
     finally:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
     e2e_s = float(np.median(times))
+    pick = int(np.argmin(np.abs(np.asarray(times) - e2e_s)))
+    stages = {k: round(v, 3) for k, v in sorted(
+        stage_runs[pick].items(), key=lambda kv: -kv[1])}
     return {
         "ccs_zmws_per_sec": n_zmws / e2e_s,
         "e2e_s": e2e_s,
         "e2e_s_min": float(np.min(times)),
         "e2e_s_max": float(np.max(times)),
         "repeats": repeats,
+        # per-stage THREAD seconds of the median run (stages overlap across
+        # WorkQueue workers, so they can sum past wall; each stage vs wall
+        # shows what binds the 1-core host)
+        "stages_s": stages,
     }
 
 
@@ -507,12 +518,16 @@ def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
         assert rc == 0
         full_fa = os.path.join(tmp, "full.fasta")
         write_fasta(full_fa, tasks)
+        from pbccs_tpu.runtime import timing
+        timing.reset()
         t0 = time.monotonic()
         rc = cli.run([os.path.join(tmp, "full.bam"), full_fa,
                       "--reportFile", os.path.join(tmp, "full.csv")]
                      + argv_tail)
         dt = time.monotonic() - t0
         assert rc == 0
+        stages = {k: round(v, 3) for k, v in sorted(
+            timing.stage_seconds().items(), key=lambda kv: -kv[1])}
         rows = {}
         with open(os.path.join(tmp, "full.csv")) as f:
             for line in f:     # headerless "label,count,pct" rows
@@ -526,7 +541,7 @@ def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
     return {"name": "cfg5_streamed_10k", "n_zmws": n_zmws,
             "tpl_len": tpl_len, "n_passes": n_passes, "chunk": chunk,
             "ccs_zmws_per_sec": round(n_zmws / dt, 4),
-            "e2e_s": round(dt, 2), "yield": rows}
+            "e2e_s": round(dt, 2), "stages_s": stages, "yield": rows}
 
 
 def main() -> None:

@@ -251,12 +251,20 @@ def run(argv: list[str] | None = None) -> int:
 
     to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta", ".fsa"))
 
+    from pbccs_tpu.runtime import timing
+
     with WorkQueue(n_threads) as wq:
-        for batch in _chunks_from_files(files, whitelist, args, log, tally):
+        it = iter(_chunks_from_files(files, whitelist, args, log, tally))
+        while True:
+            with timing.stage("read"):
+                batch = next(it, None)
+            if batch is None:
+                break
             for chunk in batch:
                 movie = chunk.id.split("/")[0]
                 movies.setdefault(movie, ReadGroupInfo(movie, "CCS"))
-            wq.produce(process_chunks, batch, settings)
+            with timing.stage("queue"):
+                wq.produce(process_chunks, batch, settings)
         wq.finalize()
         for sub_tally in wq.results():
             tally.merge(sub_tally)
@@ -266,8 +274,9 @@ def run(argv: list[str] | None = None) -> int:
 
     if to_fasta:
         from pbccs_tpu.io.fasta import write_fasta
-        write_fasta(args.output,
-                    ((f"{r.id}/ccs", r.sequence) for r in tally.results))
+        with timing.stage("write"):
+            write_fasta(args.output,
+                        ((f"{r.id}/ccs", r.sequence) for r in tally.results))
     else:
         header = BamHeader(read_groups=list(movies.values()),
                            program_lines=[
@@ -277,18 +286,20 @@ def run(argv: list[str] | None = None) -> int:
         # output BAM (reference src/main/ccs.cpp:120, 380)
         from pbccs_tpu.io.pbi import PbiBuilder, read_group_numeric_id
         uposs = []
-        with BamWriter(args.output, header) as bw:
-            for result in tally.results:
-                uposs.append(bw.write(writer_record(result)))
-            bw_handle = bw
-        with PbiBuilder(args.output + ".pbi") as pbi:
-            for result, upos in zip(tally.results, uposs):
-                movie = result.id.split("/")[0]
-                hole = int(result.id.split("/")[1])
-                pbi.add_record(
-                    read_group_numeric_id(make_read_group_id(movie, "CCS")),
-                    -1, -1, hole, result.predicted_accuracy, 0,
-                    bw_handle.voffset(upos))
+        with timing.stage("write"):
+            with BamWriter(args.output, header) as bw:
+                for result in tally.results:
+                    uposs.append(bw.write(writer_record(result)))
+                bw_handle = bw
+            with PbiBuilder(args.output + ".pbi") as pbi:
+                for result, upos in zip(tally.results, uposs):
+                    movie = result.id.split("/")[0]
+                    hole = int(result.id.split("/")[1])
+                    pbi.add_record(
+                        read_group_numeric_id(
+                            make_read_group_id(movie, "CCS")),
+                        -1, -1, hole, result.predicted_accuracy, 0,
+                        bw_handle.voffset(upos))
 
     with open(args.reportFile, "w") as rf:
         write_results_report(rf, tally)

@@ -37,7 +37,8 @@ from pbccs_tpu.ops.fwdbwd import (
     banded_forward,
     forward_loglik,
 )
-from pbccs_tpu.ops.fwdbwd_pallas import _MAX_SHIFT as _MAX_BAND_SHIFT, fills_use_pallas
+from pbccs_tpu.ops.fwdbwd import MAX_BAND_ADVANCE as _MAX_BAND_SHIFT
+from pbccs_tpu.ops.fwdbwd_pallas import fills_use_pallas
 from pbccs_tpu.utils import next_pow2 as _next_pow2
 from pbccs_tpu.ops.mutation_score import (
     INS,
@@ -57,9 +58,12 @@ _AB_MISMATCH_TOL = 1e-3  # reference SimpleRecursor.cpp:53
 
 def mated_mask(ll_a, ll_b, rlens, tstarts, tends):
     """Reads whose alpha/beta fills mate: |1 - LL_a/LL_b| within tolerance,
-    both finite, and band shift representable (reads whose band advances
-    more than _MAX_SHIFT rows/column are dropped deterministically -- the
-    reference's AlphaBetaMismatch drop, SimpleRecursor.cpp:683-688).
+    both finite, and read-vs-window slope plausible.  The slope gate
+    (rlens <= MAX_BAND_ADVANCE * window span) is deliberate POLICY, not a
+    kernel constraint (the circular-lane kernels represent any band
+    advance): a read more than ~8x its template window is insert-junk the
+    reference also sheds, via AlphaBetaMismatchException
+    (SimpleRecursor.cpp:683-688).
     All args are host numpy arrays with matching leading shape."""
     mated = np.abs(1.0 - ll_a / np.where(ll_b == 0, 1.0, ll_b)) <= _AB_MISMATCH_TOL
     mated &= np.isfinite(ll_a) & np.isfinite(ll_b)
@@ -98,13 +102,18 @@ def guided_fill_passes(jmax: int) -> int:
     rebanding + flip-flop (SimpleRecursor.cpp:642-757).  Short templates
     drift well within W/2 (measured +-16 rows at 2 kb) and skip the cost.
 
-    Env override PBCCS_GUIDED: integer pass count, or 0 to disable."""
+    Env override PBCCS_GUIDED: integer pass count, or 0 to disable.
+
+    Thresholds from the drift model (std ~ sqrt(2 * p_indel * L) rows):
+    at 2 kb measured drift is +-16 (well inside W/2 = 48, no passes); at
+    3 kb ~2 sigma reaches W/2 (start guiding); by 8 kb+ the diagonal can
+    be multiple band-widths off (two passes)."""
     env = os.environ.get("PBCCS_GUIDED")
     if env is not None:
         return max(0, int(env))
-    if jmax <= 2048:
+    if jmax <= 3072:
         return 0
-    return 1 if jmax <= 6144 else 2
+    return 1 if jmax <= 8192 else 2
 
 
 def fill_alpha_beta_batch(reads, rlens, win_tpl, win_trans, wlens, width: int,

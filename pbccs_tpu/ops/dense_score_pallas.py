@@ -97,9 +97,18 @@ def _interpret() -> bool:
 def whole_row_mode(jmax: int) -> bool:
     """Whether the kernel runs in whole-row mode at this bucket (each ref
     holds a read's full padded row in VMEM) vs streamed halo'd blocks.
-    One source of truth for the kernel and observability reporting."""
-    jm_pad = -(-jmax // _PB) * _PB
-    return jm_pad <= 1024
+    One source of truth for the kernel and observability reporting.
+
+    Default OFF since the circular-lane kernels: whole-row mode slices
+    every ref at a DATA-DEPENDENT sublane offset (base_off from
+    live_ref), and with the select chains gone that per-access cost
+    outweighs the halo'd views it avoids (same-draw A/B on the chip:
+    halo 183.9 vs whole-row 175.9 ZMW/s at the headline config).
+    Env override PBCCS_WHOLE_ROW=1 re-enables for measurement."""
+    env = os.environ.get("PBCCS_WHOLE_ROW")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "off", "no", "")
+    return False
 
 
 def cell_vmem_bytes(jmax: int, width: int) -> int:

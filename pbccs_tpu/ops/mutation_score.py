@@ -268,19 +268,6 @@ def scale_suffix(log_scales):
 # --------------------------------------------------------------------------
 
 
-def _shift_last(x, t: int):
-    """y[..., k] = x[..., k+t], zeros shifted in; static t."""
-    if t == 0:
-        return x
-    W = x.shape[-1]
-    if abs(t) >= W:
-        return jnp.zeros_like(x)
-    pad = [(0, 0)] * (x.ndim - 1)
-    if t > 0:
-        return jnp.pad(x[..., t:], pad + [(0, t)])
-    return jnp.pad(x[..., :t], pad + [(-t, 0)])
-
-
 def _row_select(idx, src):
     """sel[m] = src[clip(idx[m], 0, n-1)] as a one-hot matmul.
 

@@ -456,17 +456,20 @@ def process_chunks(chunks: Sequence[Chunk],
                 tally.results.append(result)
         return tally
 
+    from pbccs_tpu.runtime import timing
+
     preps: list[PreparedZmw] = []
-    for chunk in chunks:
-        try:
-            failure, prep = prepare_chunk(chunk, settings)
-        except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
-            tally.tally(Failure.OTHER)
-            continue
-        if failure is not None:
-            tally.tally(failure)
-        else:
-            preps.append(prep)
+    with timing.stage("draft"):
+        for chunk in chunks:
+            try:
+                failure, prep = prepare_chunk(chunk, settings)
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                tally.tally(Failure.OTHER)
+                continue
+            if failure is not None:
+                tally.tally(failure)
+            else:
+                preps.append(prep)
     if not preps:
         return tally
 
@@ -590,7 +593,9 @@ def process_chunks(chunks: Sequence[Chunk],
         skip = skip | {z for z, r in enumerate(refine_results)
                        if not r.converged}
         qvs = polisher.consensus_qvs(skip=skip)
-        polish_ms = (time.monotonic() - t0) * 1e3 / max(len(preps), 1)
+        polish_s = time.monotonic() - t0
+        timing.add_stage("polish", polish_s)
+        polish_ms = polish_s * 1e3 / max(len(preps), 1)
 
         # tallies accumulate into a local batch tally so a mid-loop fault
         # cannot double-count ZMWs when the serial fallback reruns them

@@ -12,6 +12,8 @@ counters; reset() starts a measurement window.
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import threading
 import time
 
@@ -19,7 +21,36 @@ import numpy as np
 
 _device_wait_s = 0.0
 _fetches = 0
+_stage_s: dict[str, float] = collections.defaultdict(float)
 _lock = threading.Lock()  # fetches may come from concurrent batch workers
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Attribute the enclosed wall time to a named pipeline stage
+    (summed across threads; see stage_seconds).  Cheap enough to leave on:
+    two perf_counter calls + one locked dict add per use."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _stage_s[name] += dt
+
+
+def add_stage(name: str, dt: float) -> None:
+    """Attribute dt seconds to a stage (for callers that already timed)."""
+    with _lock:
+        _stage_s[name] += dt
+
+
+def stage_seconds() -> dict[str, float]:
+    """Per-stage accumulated THREAD time since reset().  With overlapped
+    workers the stages can sum past wall time; the e2e attribution compares
+    each stage against wall to find what binds the 1-core host."""
+    with _lock:
+        return dict(_stage_s)
 
 
 def device_fetch(arr, dtype=None) -> np.ndarray:
@@ -38,6 +69,7 @@ def reset() -> None:
     global _device_wait_s, _fetches
     _device_wait_s = 0.0
     _fetches = 0
+    _stage_s.clear()
 
 
 def device_wait_seconds() -> float:
