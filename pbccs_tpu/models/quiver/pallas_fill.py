@@ -207,9 +207,12 @@ def _batch(coeff_fn, feat, rlens, tpls, tlens, config, W, pin_start, pin_end,
     outs = jax.vmap(
         lambda f, i, t, jl, o: coeff_fn(
             f, i, t.astype(jnp.int32), jl, o, W, pp, use_merge,
-            jnp.asarray(pin_start), jnp.asarray(pin_end))
+            jnp.asarray(pin_start), jnp.asarray(pin_end)),
+        out_axes=(1, 1, 1, 1, 1, 0, 0),
     )(feat, I, tpls, J, offsets)
-    cm, cd, cc, cg, mask, seed, seedcol = _pad_r(list(outs), R, Rp)
+    cm, cd, cc, cg, mask, seed, seedcol = outs
+    cm, cd, cc, cg, mask = _pad_r([cm, cd, cc, cg, mask], R, Rp, axis=1)
+    seed, seedcol = _pad_r([seed, seedcol], R, Rp)
     vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol,
                          rev_store=rev_store, cg=cg)
     return vals, ls, offsets, nc

@@ -416,12 +416,15 @@ def _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store: bool,
               cg=None, backward: bool | None = None):
     """Invoke the column-scan kernel.
 
-    cm/cd/cc: (R, nc, W); mask: (R, nc); seed: (R, W); seedcol: (R,).
+    cm/cd/cc: (nc, R, W) KERNEL layout (columns leading -- produced
+    directly by the coefficient vmaps with out_axes=1, so no transpose of
+    the multi-MB coefficient tensors sits between precompute and kernel);
+    mask: (nc, R); seed: (R, W); seedcol: (R,).
     Returns vals (R, nc, W) and log-scales (R, nc).  With rev_store, output
     column t holds kernel column nc-1-t.  Passing cg engages the Merge
     carry (Quiver recurrence).  backward sets the kernel's roll/scan
     direction (defaults to rev_store)."""
-    R, nc, W = cm.shape
+    nc, R, W = cm.shape
     merge = cg is not None
     backward = rev_store if backward is None else backward
     # the Merge carry (Quiver) doubles the live column state (prev2 + its
@@ -431,11 +434,8 @@ def _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store: bool,
     assert nc % jb == 0 and R % rb == 0
     njb = nc // jb
 
-    # kernel layout: (columns, R, W) / (columns, R, 1)
-    cm_k = jnp.transpose(cm, (1, 0, 2))
-    cd_k = jnp.transpose(cd, (1, 0, 2))
-    cc_k = jnp.transpose(cc, (1, 0, 2))
-    mk_k = jnp.transpose(mask)[:, :, None]
+    cm_k, cd_k, cc_k = cm, cd, cc
+    mk_k = mask[:, :, None]
 
     kernel = functools.partial(_fill_kernel, jb_size=jb, rev_store=rev_store,
                                merge=merge, backward=backward)
@@ -457,7 +457,7 @@ def _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store: bool,
     scratch = [pltpu.VMEM((rb, W), jnp.float32)]
     if merge:
         in_specs += [in_col]                             # cg
-        operands += [jnp.transpose(cg, (1, 0, 2))]
+        operands += [cg]
         scratch += [pltpu.VMEM((rb, W), jnp.float32),    # prev2
                     pltpu.VMEM((rb, 1), jnp.float32)]    # its scale
     vals, ls = pl.pallas_call(
@@ -500,10 +500,16 @@ def _pad_reads(r: int) -> int:
     return ((r + rb - 1) // rb) * rb
 
 
-def _pad_r(arrs, R, Rp):
+def _pad_r(arrs, R, Rp, axis: int = 0):
+    """Pad the read axis (at `axis`) from R to Rp rows."""
     if Rp == R:
         return arrs
-    return [jnp.pad(a, [(0, Rp - R)] + [(0, 0)] * (a.ndim - 1)) for a in arrs]
+    def pad(a):
+        assert a.ndim > axis, (a.shape, axis)
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, Rp - R)
+        return jnp.pad(a, widths)
+    return [pad(a) for a in arrs]
 
 
 # --------------------------------------------------------------------------
@@ -535,10 +541,11 @@ def pallas_forward_batch(reads, rlens, tpls, trans, tlens, width: int,
         lambda r, i, t, tr, jl, o: _forward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,
             width, pr_miscall),
+        out_axes=(1, 1, 1, 1, 0, 0),
     )(reads, I, tpls, trans, J, offsets)
 
-    cm, cd, cc, mask, seed, seedcol = _pad_r(
-        [cm, cd, cc, mask, seed, seedcol], R, Rp)
+    cm, cd, cc, mask = _pad_r([cm, cd, cc, mask], R, Rp, axis=1)
+    seed, seedcol = _pad_r([seed, seedcol], R, Rp)
     vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store=False)
     return BandedMatrix(vals[:R, : Jmax + 1], offsets[:, : Jmax + 1],
                         ls[:R, : Jmax + 1])
@@ -561,10 +568,11 @@ def pallas_backward_batch(reads, rlens, tpls, trans, tlens, width: int,
         lambda r, i, t, tr, jl, o: _backward_coeffs(
             r.astype(jnp.int32), i, t.astype(jnp.int32), tr, jl, o,
             width, pr_miscall),
+        out_axes=(1, 1, 1, 1, 0, 0),
     )(reads, I, tpls, trans, J, offsets)
 
-    cm, cd, cc, mask, seed, seedcol = _pad_r(
-        [cm, cd, cc, mask, seed, seedcol], R, Rp)
+    cm, cd, cc, mask = _pad_r([cm, cd, cc, mask], R, Rp, axis=1)
+    seed, seedcol = _pad_r([seed, seedcol], R, Rp)
     vals, ls = _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store=True)
     # with rev_store, output column t = kernel col nc-1-t = beta col
     # Jmax - (nc-1-t) => beta col j sits at t = j + (nc-1-Jmax); lanes are

@@ -313,6 +313,72 @@ def _batch_edge(reads, rlens, win_tpl, win_trans, wlens,
         zidx * R + ridx, pw, mt, pb, ptr, psh, width, use_pallas)
 
 
+@dataclasses.dataclass
+class _Continuation:
+    """Device-loop outcome state that later BatchPolisher calls must
+    respect — the straggler-continuation + QV-cache bookkeeping that grew
+    ad hoc across refine_device/consensus_qvs (round-4 review ask).
+
+    Invariants:
+    * `sub_polishers` maps parent ZMW index -> (sub BatchPolisher, sub
+      row).  Non-empty implies `stale_fills`: those parent rows' device
+      fills are PRE-continuation, so any later refine() must rebuild
+      (begin_refine) before reusing them; QVs for those ZMWs must come
+      from the sub-polisher (delegated_qvs), never the parent sweep.
+    * `qv_cache` holds (skip set at sweep time, (Z, Jmax) int32 QVs) from
+      the loop's eager run_qv_ints sweep against the loop's FINAL
+      templates.  It is only valid while those templates are current:
+      begin_refine clears it.  A cached sweep serves a later
+      consensus_qvs call iff no ZMW live in that call was skipped in the
+      cached sweep.
+    """
+
+    stale_fills: bool = False
+    qv_cache: tuple | None = None
+    sub_polishers: dict = dataclasses.field(default_factory=dict)
+
+    def begin_refine(self, polisher: "BatchPolisher") -> None:
+        """Entering a new refinement: rebuild stale fills from the current
+        host templates and drop state tied to the previous loop's end."""
+        if self.stale_fills:
+            polisher._setup(first=False)
+            self.stale_fills = False
+        self.sub_polishers = {}
+        self.qv_cache = None
+
+    def record_continuation(self, mapping: dict) -> None:
+        """A straggler sub-batch finished rows for these parent ZMWs."""
+        self.sub_polishers.update(mapping)
+        self.stale_fills = True
+
+    def cached_qvs(self, n_zmws: int, skip: set, tpls) -> list | None:
+        """Serve consensus QVs from the loop-time sweep if every ZMW live
+        in THIS call was live in the cached sweep too."""
+        if self.qv_cache is None:
+            return None
+        cached_skip, qv_m = self.qv_cache
+        if (set(range(n_zmws)) - skip) & cached_skip:
+            return None
+        return [np.zeros(0, np.int32) if z in skip
+                else qv_m[z, : len(tpls[z])].copy() for z in range(n_zmws)]
+
+    def delegated_qvs(self, out: list, skip: set) -> list:
+        """Overwrite QVs of continuation-finished ZMWs from their
+        sub-polishers (grouped per sub so each sweeps at most once)."""
+        subs = self.sub_polishers
+        for sub in {id(s): s for s, _ in subs.values()}.values():
+            wanted = {i: z for z, (s, i) in subs.items()
+                      if s is sub and z not in skip}
+            if not wanted:
+                continue  # all delegated ZMWs are skipped: no sweep at all
+            sub_skip = {i for z, (s, i) in subs.items()
+                        if s is sub and z in skip}
+            sub_q = sub.consensus_qvs(skip=sub_skip)
+            for i, z in wanted.items():
+                out[z] = sub_q[i]
+        return out
+
+
 class BatchPolisher:
     """Z bucketed ZMWs polished in lockstep on one device mesh.
 
@@ -397,6 +463,7 @@ class BatchPolisher:
             self._real_rows[z, : int(self._n_reads[z])] = True
 
         self._stats_host = None  # lazily fetched AddRead statistics
+        self._cont = _Continuation()
         self._host_tables = np.stack(
             [snr_to_transition_table_host(self._snrs[z]) for z in range(Z)]
         ).astype(np.float32)
@@ -858,7 +925,7 @@ class BatchPolisher:
         """Splice per-ZMW mutations, remap read windows, rebuild fills."""
         changed: list[int] = []
         self._tpl_lengths_cache = None
-        self._qv_cache = None
+        self._cont.qv_cache = None
         for z, best in enumerate(best_per_zmw):
             if not best:
                 continue
@@ -957,18 +1024,12 @@ class BatchPolisher:
             return None
         opts = opts or RefineOptions()
         budget = opts.max_iterations if budget is None else budget
-        if getattr(self, "_stale_fills", False):
-            # a previous refine's straggler continuation left the adopted
-            # fills at pre-continuation state for those rows; rebuild from
-            # the current (host) templates before refining again
-            self._setup(first=False)
-            self._stale_fills = False
-        self._sub_polishers = {}
+        # rebuild-if-stale + drop loop-end state (invariants: _Continuation)
+        self._cont.begin_refine(self)
         Z, R, Jmax = self._Z, self._R, self._Jmax
 
         st = self._loop_state(skip, it0=opts.max_iterations - budget)
 
-        self._qv_cache = None
         loop_statics = dict(
             width=self._W, use_pallas=fills_use_pallas(),
             max_iterations=opts.max_iterations,
@@ -1027,8 +1088,8 @@ class BatchPolisher:
         if overflow_h[0]:
             return None  # host loop re-runs from the polisher's last state
         if not h[0, 6]:  # no tiny-window fallback in the QV sweep
-            self._qv_cache = (frozenset(skip or ()),
-                              h[:, 7 + Jmax + 2 * R:].astype(np.int32))
+            self._cont.qv_cache = (frozenset(skip or ()),
+                                   h[:, 7 + Jmax + 2 * R:].astype(np.int32))
 
         tpl_h = h[:, 7: 7 + Jmax].astype(np.int8)
         for z in range(self.n_zmws):
@@ -1107,10 +1168,9 @@ class BatchPolisher:
                     n_tested=results[z].n_tested + r.n_tested,
                     n_applied=results[z].n_applied + r.n_applied,
                     iterations=results[z].iterations + r.iterations)
-                self._sub_polishers[z] = (sub, i)
             self._tpl_lengths_cache = None
-            self._stale_fills = True  # parent fills for straggler rows are
-            # pre-continuation; a later refine() must rebuild (see above)
+            self._cont.record_continuation(
+                {z: (sub, i) for i, z in enumerate(stragglers)})
         return results
 
     def straggler_shape_min_z(self) -> int:
@@ -1244,19 +1304,9 @@ class BatchPolisher:
         finished in a straggler sub-polisher (refine_device) pull their QVs
         from it -- the parent's fills for those slots are pre-continuation."""
         skip = set(skip or ())
-        subs = getattr(self, "_sub_polishers", None) or {}
-        out = self._consensus_qvs_impl(skip | set(subs))
-        for sub in {id(s): s for s, _ in subs.values()}.values():
-            wanted = {i: z for z, (s, i) in subs.items()
-                      if s is sub and z not in skip}
-            if not wanted:
-                continue  # all delegated ZMWs are skipped: no sweep at all
-            sub_skip = {i for z, (s, i) in subs.items()
-                        if s is sub and z in skip}
-            sub_q = sub.consensus_qvs(skip=sub_skip)
-            for i, z in wanted.items():
-                out[z] = sub_q[i]
-        return out
+        out = self._consensus_qvs_impl(
+            skip | set(self._cont.sub_polishers))
+        return self._cont.delegated_qvs(out, skip)
 
     def _consensus_qvs_impl(self, skip) -> list[np.ndarray]:
         # refine_device leaves per-position integer QVs computed on the
@@ -1266,14 +1316,9 @@ class BatchPolisher:
         # f64 on host -- identical except where the exact QV lands within
         # f32 rounding of a .5 boundary (a <=1-unit knife-edge, invisible
         # after the [0, 93] output clamp)
-        cache = getattr(self, "_qv_cache", None)
-        if cache is not None:
-            cached_skip, qv_m = cache
-            live = set(range(self.n_zmws)) - set(skip)
-            if not (live & cached_skip):
-                return [np.zeros(0, np.int32) if z in skip
-                        else qv_m[z, : len(self.tpls[z])].copy()
-                        for z in range(self.n_zmws)]
+        cached = self._cont.cached_qvs(self.n_zmws, set(skip), self.tpls)
+        if cached is not None:
+            return cached
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         arrs = [empty if z in skip else mutlib.enumerate_unique_arrays(t)
                 for z, t in enumerate(self.tpls[: self.n_zmws])]

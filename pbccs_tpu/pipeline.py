@@ -259,25 +259,29 @@ def prepare_chunk(chunk: Chunk, settings: ConsensusSettings
     if float(np.min(chunk.snr)) < settings.min_snr:
         return Failure.POOR_SNR, None
 
+    from pbccs_tpu.runtime import timing
+
     reads = filter_reads(chunk.reads, settings.min_length)
     if not reads or all(r is None for r in reads):
         return Failure.NO_SUBREADS, None
 
-    css, keys, summaries = poa_consensus(reads, settings.max_poa_coverage)
+    with timing.stage("draft.poa"):
+        css, keys, summaries = poa_consensus(reads, settings.max_poa_coverage)
     if len(css) < settings.min_length:
         return Failure.TOO_SHORT, None
 
     # map reads onto the draft
     mapped: list[MappedRead] = []
     n_unmappable = 0
-    for r, k in zip(reads, keys):
-        if r is None or k < 0:
-            continue
-        mr = extract_mapped_read(r, summaries[k], settings.min_length)
-        if mr is None:
-            n_unmappable += 1
-            continue
-        mapped.append(mr)
+    with timing.stage("draft.map"):
+        for r, k in zip(reads, keys):
+            if r is None or k < 0:
+                continue
+            mr = extract_mapped_read(r, summaries[k], settings.min_length)
+            if mr is None:
+                n_unmappable += 1
+                continue
+            mapped.append(mr)
 
     n_candidates = sum(1 for k in keys if k >= 0)
     if not mapped:

@@ -69,7 +69,8 @@ def reset() -> None:
     global _device_wait_s, _fetches
     _device_wait_s = 0.0
     _fetches = 0
-    _stage_s.clear()
+    with _lock:
+        _stage_s.clear()
 
 
 def device_wait_seconds() -> float:

@@ -330,7 +330,7 @@ def test_straggler_continuation_plumbing(rng, monkeypatch):
     res = p.refine(RefineOptions(max_iterations=6))
     monkeypatch.setattr(dr, "run_refine_loop", real_loop)
 
-    assert getattr(p, "_sub_polishers", None) and 1 in p._sub_polishers
+    assert p._cont.sub_polishers and 1 in p._cont.sub_polishers
     assert res[1].converged  # the sub-polisher finished it
     # the continuation carries the REMAINING budget: parent spent 1 round,
     # so total iterations can never exceed the single max_iterations bound
