@@ -68,6 +68,37 @@ def _ab_program(feats, rl, tp, tl, *, config, width):
     return jax.vmap(one)(feats, rl, tp, tl)
 
 
+@functools.partial(jax.jit, static_argnames=("config", "width"))
+def _pallas_ab_program(feats, rl, tp, tl, *, config, width):
+    """Pallas-batch AddRead fills + LLs as ONE jitted program.  Eager
+    pallas_call bypasses jit executable caching AND the persistent
+    compilation cache, so every process paid the full remote Mosaic
+    compile again -- the quiver bench's repeated 45-minute walls."""
+    from pbccs_tpu.models.quiver.pallas_fill import (
+        pallas_quiver_backward_batch, pallas_quiver_forward_batch,
+        quiver_loglik_batch)
+
+    alpha = pallas_quiver_forward_batch(feats, rl, tp, tl, config, width)
+    beta = pallas_quiver_backward_batch(feats, rl, tp, tl, config, width)
+    ll_a = quiver_loglik_batch(alpha, rl, tl)
+    jcols = jnp.arange(beta.log_scales.shape[1])[None, :]
+    ll_b = (jnp.log(jnp.maximum(beta.vals[:, 0, 0], 1e-30))
+            + jnp.where(jcols <= tl[:, None], beta.log_scales, 0.0
+                        ).sum(axis=1))
+    return ll_a, ll_b
+
+
+@functools.partial(jax.jit, static_argnames=("config", "width"))
+def _pallas_lls_program(feats, rl, tp, tl, *, config, width):
+    """Pallas-batch forward LLs as ONE jitted program (see
+    _pallas_ab_program for why jit is load-bearing here)."""
+    from pbccs_tpu.models.quiver.pallas_fill import (
+        pallas_quiver_forward_batch, quiver_loglik_batch)
+
+    alpha = pallas_quiver_forward_batch(feats, rl, tp, tl, config, width)
+    return quiver_loglik_batch(alpha, rl, tl)
+
+
 
 
 
@@ -85,6 +116,14 @@ class QuiverMultiReadScorer:
         self._tstarts = np.asarray(tstarts, np.int32)
         self._tends = np.asarray(tends, np.int32)
         self._Imax = _next_pow2(max((len(f) for f in reads), default=8) + 8, 64)
+        # template-axis bucket PINNED with growth headroom (one formula:
+        # _jmax_bucket below): recomputing next_pow2(L) from the CURRENT
+        # length minted a fresh Jmax -- and recompiled the whole
+        # fill-program menu through the remote compile helper, ~1-2 min per
+        # program -- every time a round's accepted indels crossed a pow2
+        # boundary.  One bucket serves every rebuild and mutated-window
+        # score; templates outgrowing it re-bucket (rare, _rebuild).
+        self._Jmax = 0      # set by _rebuild(first=True)'s bucket guard
         self._W = self.config.banding.band_width
         self._dev_feats = [feature_arrays(f, self._Imax) for f in reads]
         self._rlens = np.asarray([min(len(f), self._Imax) for f in reads], np.int32)
@@ -108,9 +147,16 @@ class QuiverMultiReadScorer:
         return QuiverFeatureArrays(*(jnp.stack([getattr(f, n) for f in feats])
                                      for n in QuiverFeatureArrays._fields))
 
+    def _jmax_bucket(self, L: int) -> int:
+        """Headroom-proportional template bucket (same policy as
+        parallel/batch._jmax_bucket, +10 for the mutated-window pad)."""
+        return _next_pow2(L + max(16, L // 32) + 10, 64)
+
     def _rebuild(self, first: bool) -> None:
         L = len(self.tpl)
-        Jmax = _next_pow2(L + 8, 64)
+        if L + 8 > self._Jmax:   # template outgrew the bucket: re-bucket
+            self._Jmax = self._jmax_bucket(L)
+        Jmax = self._Jmax
         self._wins = []
         wins_np, wlens = [], []
         for r in range(self.n_reads):
@@ -136,22 +182,13 @@ class QuiverMultiReadScorer:
         if fills_use_pallas():
             # one batched Pallas launch over the read axis (the device
             # analogue of the reference's per-read SSE recursor,
-            # SseRecursor.cpp:66-130)
-            from pbccs_tpu.models.quiver.pallas_fill import (
-                pallas_quiver_backward_batch, pallas_quiver_forward_batch,
-                quiver_loglik_batch)
-
-            alpha = pallas_quiver_forward_batch(feats, rl, tp, tl,
-                                                self.config, self._W)
-            beta = pallas_quiver_backward_batch(feats, rl, tp, tl,
-                                                self.config, self._W)
-            ll_a = np.asarray(quiver_loglik_batch(alpha, rl, tl),
-                              np.float64)[:R]
-            jcols = np.arange(beta.log_scales.shape[1])[None, :]
-            ll_b = (np.log(np.maximum(np.asarray(beta.vals[:, 0, 0]), 1e-30))
-                    + np.where(jcols <= np.asarray(tl)[:, None],
-                               np.asarray(beta.log_scales), 0.0).sum(axis=1)
-                    )[:R]
+            # SseRecursor.cpp:66-130), as ONE jitted program so the
+            # executable + persistent caches apply
+            lls_a, lls_b = _pallas_ab_program(feats, rl, tp, tl,
+                                              config=self.config,
+                                              width=self._W)
+            ll_a = np.asarray(lls_a, np.float64)[:R]
+            ll_b = np.asarray(lls_b, np.float64)[:R]
         else:
             # XLA-recursor path: one jitted batched program
             lls_a, lls_b = _ab_program(feats, rl, tp, tl,
@@ -195,7 +232,7 @@ class QuiverMultiReadScorer:
         if not muts:
             return np.zeros(0)
         L = len(self.tpl)
-        jmax = _next_pow2(L + 10, 64)
+        jmax = self._Jmax        # pinned bucket (see __init__)
         scores = np.zeros(len(muts))
 
         groups: dict[tuple[int, int, int], list[int]] = {}
@@ -273,12 +310,8 @@ class QuiverMultiReadScorer:
             np.repeat(self._rlens[np.asarray(rds)], Mpad),
             (0, rows_p - rows), constant_values=2))
         if fills_use_pallas():
-            from pbccs_tpu.models.quiver.pallas_fill import (
-                pallas_quiver_forward_batch, quiver_loglik_batch)
-
-            alpha = pallas_quiver_forward_batch(feats, rl, tp, tl,
-                                                self.config, self._W)
-            lls = quiver_loglik_batch(alpha, rl, tl)
+            lls = _pallas_lls_program(feats, rl, tp, tl, config=self.config,
+                                      width=self._W)
         else:
             lls = _lls_program(feats, rl, tp, tl, config=self.config,
                                width=self._W)
