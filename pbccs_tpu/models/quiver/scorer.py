@@ -148,8 +148,10 @@ class QuiverMultiReadScorer:
                                      for n in QuiverFeatureArrays._fields))
 
     def _jmax_bucket(self, L: int) -> int:
-        """Headroom-proportional template bucket (same policy as
-        parallel/batch._jmax_bucket, +10 for the mutated-window pad)."""
+        """Headroom-proportional template bucket.  Shares only the headroom
+        term with parallel/batch._jmax_bucket (+10 for the mutated-window
+        pad); this rounds up to a power of two so the Pallas fill programs
+        see a tiny shape menu, where batch pads to a multiple of 64."""
         return _next_pow2(L + max(16, L // 32) + 10, 64)
 
     def _rebuild(self, first: bool) -> None:
@@ -157,13 +159,11 @@ class QuiverMultiReadScorer:
         if L + 8 > self._Jmax:   # template outgrew the bucket: re-bucket
             self._Jmax = self._jmax_bucket(L)
         Jmax = self._Jmax
-        self._wins = []
         wins_np, wlens = [], []
         for r in range(self.n_reads):
             win = self._window_codes(r, self.tpl)
             wpad = np.full(Jmax, 4, np.int8)
             wpad[:len(win)] = win
-            self._wins.append((jnp.asarray(wpad), jnp.int32(len(win))))
             wins_np.append(wpad)
             wlens.append(len(win))
         # read axis pads to pow2 (shared contract for both fill backends)
@@ -187,14 +187,12 @@ class QuiverMultiReadScorer:
             lls_a, lls_b = _pallas_ab_program(feats, rl, tp, tl,
                                               config=self.config,
                                               width=self._W)
-            ll_a = np.asarray(lls_a, np.float64)[:R]
-            ll_b = np.asarray(lls_b, np.float64)[:R]
         else:
             # XLA-recursor path: one jitted batched program
             lls_a, lls_b = _ab_program(feats, rl, tp, tl,
                                        config=self.config, width=self._W)
-            ll_a = np.asarray(lls_a, np.float64)[:R]
-            ll_b = np.asarray(lls_b, np.float64)[:R]
+        ll_a = np.asarray(lls_a, np.float64)[:R]
+        ll_b = np.asarray(lls_b, np.float64)[:R]
         self.baselines = ll_a
         denom = np.where(ll_b == 0, 1.0, ll_b)
         mated = (np.abs(1.0 - ll_a / denom) <= _AB_MISMATCH_TOL) & \

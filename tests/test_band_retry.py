@@ -149,7 +149,8 @@ def test_pipeline_band_retry_stays_batched_on_revert(rng, monkeypatch):
     tally, serial_ids, widths = _band_retry_pipeline(rng, monkeypatch,
                                                      drop_in_wide=True)
     assert serial_ids == []
-    assert widths == [96, 192]            # narrow batch + wide retry batch
+    # narrow batch at the scheduled W, then ONE wide retry batch at 2x
+    assert len(widths) == 2 and widths[1] == 2 * widths[0]
     assert tally.counts[Failure.SUCCESS] == 2
     assert len(tally.results) == 2
     rb1 = next(r for r in tally.results if r.id == "rb/1")
@@ -166,7 +167,7 @@ def test_pipeline_band_retry_picks_wider_band_when_it_mates(rng,
     tally, serial_ids, widths = _band_retry_pipeline(rng, monkeypatch,
                                                      drop_in_wide=False)
     assert serial_ids == []
-    assert widths == [96, 192]
+    assert len(widths) == 2 and widths[1] == 2 * widths[0]
     assert tally.counts[Failure.SUCCESS] == 2
     rb1 = next(r for r in tally.results if r.id == "rb/1")
     # the wide build mated every read: the reported statuses carry no drop
