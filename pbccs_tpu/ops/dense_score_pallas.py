@@ -253,6 +253,35 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     def in_band(rows, o):
         return (rows >= o) & (rows < o + W)
 
+    def ext_parts(prev, o_prev, rows):
+        """The (pm1, p0) cross-column operands of ExtendAlpha — they
+        depend only on (prev, o_prev, rows), so callers sharing a
+        previous column compute them ONCE (the four SUB/INS ext0
+        columns share everything but the insertion coefficient; the
+        s/i second columns at one base share their prev)."""
+        pm1 = jnp.where(in_band(rows - 1, o_prev),
+                        _shift_lanes_circ(prev, 1), 0.0)
+        p0 = jnp.where(in_band(rows, o_prev), prev, 0.0)
+        return pm1, p0
+
+    def ext_b(pm1, p0, rows, em, prev_tr):
+        """b-coefficient from shared cross-column operands + emission."""
+        in_read = (rows >= 1) & (rows <= I)
+        b = pm1 * em * jnp.where(rows < I, prev_tr[:, TRANS_MATCH:TRANS_MATCH + 1], 0.0)
+        b = b + jnp.where(rows != I,
+                          p0 * prev_tr[:, TRANS_DARK:TRANS_DARK + 1], 0.0)
+        return jnp.where(in_read, b, 0.0)
+
+    def cmask(rows, o_col):
+        """Shared insertion-coefficient gate of one (rows, o_col) pair."""
+        return (rows > 1) & (rows < I) & (rows > o_col)
+
+    def ext_c(mask_c, rbase, next_b, cur_tr):
+        ins_em = jnp.where(rbase == next_b,
+                           cur_tr[:, TRANS_BRANCH:TRANS_BRANCH + 1],
+                           cur_tr[:, TRANS_STICK:TRANS_STICK + 1] / 3.0)
+        return jnp.where(mask_c, ins_em, 0.0)
+
     def ext_col(prev, o_prev, o_col, rows, rbase, cur_b, next_b,
                 prev_tr, cur_tr):
         """One interior ExtendAlpha column over (_PB, W); mirrors
@@ -260,32 +289,34 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         Circular lanes: the cross-column operand is one static roll +
         in-band mask (any offset delta), replacing the bounded
         shift-variant selects."""
-        in_read = (rows >= 1) & (rows <= I)
+        pm1, p0 = ext_parts(prev, o_prev, rows)
         em = jnp.where(rbase == cur_b, hit, miss)
-        pm1 = jnp.where(in_band(rows - 1, o_prev), _shift_lanes_circ(prev, 1), 0.0)
-        p0 = jnp.where(in_band(rows, o_prev), prev, 0.0)
-        b = pm1 * em * jnp.where(rows < I, prev_tr[:, TRANS_MATCH:TRANS_MATCH + 1], 0.0)
-        b = b + jnp.where(rows != I,
-                          p0 * prev_tr[:, TRANS_DARK:TRANS_DARK + 1], 0.0)
-        b = jnp.where(in_read, b, 0.0)
-        ins_em = jnp.where(rbase == next_b,
-                           cur_tr[:, TRANS_BRANCH:TRANS_BRANCH + 1],
-                           cur_tr[:, TRANS_STICK:TRANS_STICK + 1] / 3.0)
-        c = jnp.where(in_read & (rows > 1) & (rows < I) & (rows > o_col),
-                      ins_em, 0.0)
+        b = ext_b(pm1, p0, rows, em, prev_tr)
+        c = ext_c(cmask(rows, o_col), rbase, next_b, cur_tr)
         return _hs_scan_circ(b, c, W)
 
-    def link(ext1, rows, rn_s1, link_tr, link_b, bcol, o_b, apre_s, bsuf_b):
-        em_link = jnp.where(rn_s1 == link_b, hit, miss)
+    def beta_pair(rows, bcol, o_b):
+        """(beta_{i+1}, beta_i) operands of LinkAlphaBeta — shared by
+        every link against the same (rows, beta column)."""
         beta_ip1 = jnp.where(in_band(rows + 1, o_b),
                              _shift_lanes_circ(bcol, -1), 0.0)
         beta_i = jnp.where(in_band(rows, o_b), bcol, 0.0)
-        match = jnp.where(rows < I,
-                          ext1 * link_tr[:, TRANS_MATCH:TRANS_MATCH + 1]
-                          * em_link * beta_ip1, 0.0)
+        return beta_ip1, beta_i
+
+    def link_shared(ext1, link_tr, mterm, beta_i, apre_s, bsuf_b):
+        """LinkAlphaBeta with the (em_link * beta_{i+1} * [rows < I])
+        match operand precomputed (mterm) — it is slot-independent for
+        every slot family linking the same beta column."""
+        match = ext1 * link_tr[:, TRANS_MATCH:TRANS_MATCH + 1] * mterm
         dele = ext1 * link_tr[:, TRANS_DARK:TRANS_DARK + 1] * beta_i
         v = jnp.sum(match + dele, axis=1)
         return jnp.log(jnp.maximum(v, _TINY)) + apre_s[:, 0] + bsuf_b[:, 0]
+
+    def link(ext1, rows, rn_s1, link_tr, link_b, bcol, o_b, apre_s, bsuf_b):
+        em_link = jnp.where(rn_s1 == link_b, hit, miss)
+        beta_ip1, beta_i = beta_pair(rows, bcol, o_b)
+        mterm = jnp.where(rows < I, em_link * beta_ip1, 0.0)
+        return link_shared(ext1, link_tr, mterm, beta_i, apre_s, bsuf_b)
 
     def at(ref, off):
         return ref[pl.dslice(base_off + _OFF0 + off, _PB)]
@@ -308,8 +339,26 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
     # ---- SUB + INS slots (s = p): patch = [prev_b, nb] --------------
     # SUB b and INS b have the IDENTICAL first extend column (same
     # patched transitions T(prev_b, nb) and same alpha seed); compute
-    # ext0 once per base and branch only on the second column, saving
-    # 4 of the 18 ext_col evaluations per position block.
+    # ext0 once per base and branch only on the second column.  The b-
+    # coefficient of ALL FOUR ext0 columns is fully shared (same prev
+    # column, emission against the same unmutated base w_m1, same
+    # transitions wt_m2) — only the insertion coefficient differs per
+    # base — and the second columns / links share their cross-column
+    # and beta operands per family; everything slot-invariant is
+    # hoisted out of the per-base loop.
+    pm1_0, p0_0 = ext_parts(a_m1, o_m1, rows_0)
+    em_0 = jnp.where(rb_0 == w_m1, hit, miss)
+    b0_shared = ext_b(pm1_0, p0_0, rows_0, em_0, wt_m2)
+    mask_c0 = cmask(rows_0, o_0)
+    mask_c1 = cmask(rows_p1, o_p1)
+    # link operands per family: s-links hit beta col p+2, i-links p+1
+    lt_p1 = rows_p1 < I
+    bip1_s, bi_s = beta_pair(rows_p1, b_p2, o_p2)
+    em_s = jnp.where(rn_p1 == w_p1, hit, miss)
+    mterm_s = jnp.where(lt_p1, em_s * bip1_s, 0.0)
+    bip1_i, bi_i = beta_pair(rows_p1, b_p1, o_p1)
+    em_i = jnp.where(rn_p1 == w_0, hit, miss)
+    mterm_i = jnp.where(lt_p1, em_i * bip1_i, 0.0)
     for b in range(4):
         t0 = pt_ref[pl.dslice(base_off + _OFF0, _PB),
                      pl.dslice((b * 2 + 0) * 4, 4)]
@@ -318,13 +367,14 @@ def _dense_kernel_body(alpha_ref, beta_ref, rbase_ref, rnext_ref, off_ref,
         t1i = pt_ref[pl.dslice(base_off + _OFF0, _PB),
                       pl.dslice((8 + b * 2 + 1) * 4, 4)]
         nb = jnp.float32(b)
-        ext0 = ext_col(a_m1, o_m1, o_0, rows_0, rb_0, w_m1, nb, wt_m2, t0)
-        ext1s = ext_col(ext0, o_0, o_p1, rows_p1, rb_p1, nb, w_p1, t0, t1s)
-        outs[b] = link(ext1s, rows_p1, rn_p1, t1s, w_p1, b_p2,
-                       o_p2, ap_0, bs_p2)
-        ext1i = ext_col(ext0, o_0, o_p1, rows_p1, rb_p1, nb, w_0, t0, t1i)
-        outs[4 + b] = link(ext1i, rows_p1, rn_p1, t1i, w_0, b_p1,
-                           o_p1, ap_0, bs_p1)
+        ext0 = _hs_scan_circ(b0_shared, ext_c(mask_c0, rb_0, nb, t0), W)
+        pm1_1, p0_1 = ext_parts(ext0, o_0, rows_p1)
+        em_1 = jnp.where(rb_p1 == nb, hit, miss)
+        b1 = ext_b(pm1_1, p0_1, rows_p1, em_1, t0)
+        ext1s = _hs_scan_circ(b1, ext_c(mask_c1, rb_p1, w_p1, t1s), W)
+        outs[b] = link_shared(ext1s, t1s, mterm_s, bi_s, ap_0, bs_p2)
+        ext1i = _hs_scan_circ(b1, ext_c(mask_c1, rb_p1, w_0, t1i), W)
+        outs[4 + b] = link_shared(ext1i, t1i, mterm_i, bi_i, ap_0, bs_p1)
     # ---- DEL slot (s = p-1): patch = [prev_b, next_b] ---------------
     t0 = pt_ref[pl.dslice(base_off + _OFF0, _PB), pl.dslice(16 * 4, 4)]
     ext0 = ext_col(a_m2, o_m2, o_m1, rows_m1, rb_m1, w_m2, w_m1,

@@ -21,6 +21,14 @@ from pbccs_tpu.models.arrow.scorer import (ADD_ALPHABETAMISMATCH,
 from pbccs_tpu.simulate import simulate_zmw
 
 
+def _scheduled_width() -> int:
+    """The W the schedule picks for this file's 150 bp templates (their
+    jmax bucket is well under the schedule's 576-column threshold)."""
+    from pbccs_tpu.models.arrow.params import (BandingOptions,
+                                               effective_band_width)
+    return effective_band_width(BandingOptions(), 256)
+
+
 def _pathological_read(rng, tpl):
     """A read with a big random block insertion: alpha/beta reliably
     unmated at any width (float32 in-column underflow)."""
@@ -151,6 +159,7 @@ def test_pipeline_band_retry_stays_batched_on_revert(rng, monkeypatch):
     assert serial_ids == []
     # narrow batch at the scheduled W, then ONE wide retry batch at 2x
     assert len(widths) == 2 and widths[1] == 2 * widths[0]
+    assert widths[0] == _scheduled_width()
     assert tally.counts[Failure.SUCCESS] == 2
     assert len(tally.results) == 2
     rb1 = next(r for r in tally.results if r.id == "rb/1")
@@ -168,6 +177,7 @@ def test_pipeline_band_retry_picks_wider_band_when_it_mates(rng,
                                                      drop_in_wide=False)
     assert serial_ids == []
     assert len(widths) == 2 and widths[1] == 2 * widths[0]
+    assert widths[0] == _scheduled_width()
     assert tally.counts[Failure.SUCCESS] == 2
     rb1 = next(r for r in tally.results if r.id == "rb/1")
     # the wide build mated every read: the reported statuses carry no drop
