@@ -26,6 +26,7 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
+    context_index,
     effective_band_width,
     revcomp,
     snr_to_transition_table_host,
@@ -74,17 +75,32 @@ def mated_mask(ll_a, ll_b, rlens, tstarts, tends):
 
 
 
-def oriented_window(strand, ts, te, tpl_f, trans_f, tpl_r, trans_r, L):
-    """Build one read's oriented template window (bases, transitions, len)."""
+def oriented_window(strand, ts, te, tpl_f, tpl_r, L, table):
+    """Build one read's oriented template window (bases, transitions, len).
+
+    Only the BASES are gathered — one (Jmax,) gather from the stacked
+    fwd/rev template.  The transition track is recomputed from the window
+    itself: win_trans[j] = T(win[j], win[j+1]) equals the full-template
+    track inside the window (template_transition_params conditions on
+    (t[i], t[i+1]); rows j >= wlen-1 are masked to zero either way), and
+    the 4-lane f32 trans gather this replaces was ~4/5 of the rebuild's
+    scalar-core gather volume on the round-5 device profile.  The (8, 4)
+    table lookup rides a tiny one-hot matmul, not a gather."""
     Jmax = tpl_f.shape[0]
     ws = jnp.where(strand == 0, ts, L - te)
     wlen = te - ts
     idx = jnp.arange(Jmax, dtype=jnp.int32)
     src = jnp.clip(ws + idx, 0, Jmax - 1)
-    base = jnp.where(strand == 0, tpl_f[src], tpl_r[src])
-    trans = jnp.where(strand == 0, trans_f[src], trans_r[src])
+    both = jnp.concatenate([tpl_f, tpl_r])
+    base = both[jnp.where(strand == 0, 0, Jmax) + src]
     win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
-    win_trans = jnp.where((idx < wlen - 1)[:, None], trans, 0.0)
+    w32 = win_tpl.astype(jnp.int32)
+    ctx = jnp.clip(context_index(w32, jnp.roll(w32, -1)), 0, 7)
+    onehot = (ctx[:, None] == jnp.arange(8)).astype(jnp.float32)
+    params = jax.lax.dot(onehot, table.astype(jnp.float32),
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+    win_trans = jnp.where((idx < wlen - 1)[:, None], params, 0.0)
     return win_tpl, win_trans, wlen
 
 
@@ -242,12 +258,11 @@ def fill_alpha_beta_batch_zr(reads, rlens, win_tpl, win_trans, wlens,
 @functools.partial(jax.jit, static_argnames=("width", "use_pallas",
                                              "guided_passes"))
 def _setup_reads(reads, rlens, strands, tstarts, tends,
-                 tpl_f, trans_f, tpl_r, trans_r, L, width: int,
+                 tpl_f, tpl_r, L, table, width: int,
                  use_pallas: bool, guided_passes: int = 0):
     """Build per-read oriented windows and fill alpha/beta for each read."""
     win_tpl, win_trans, wlens = jax.vmap(
-        lambda s, a, b: oriented_window(s, a, b, tpl_f, trans_f,
-                                        tpl_r, trans_r, L)
+        lambda s, a, b: oriented_window(s, a, b, tpl_f, tpl_r, L, table)
     )(strands, tstarts, tends)
     alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
         reads, rlens, win_tpl, win_trans, wlens, width, use_pallas,
@@ -500,8 +515,8 @@ class ArrowMultiReadScorer:
             jnp.asarray(self._reads), jnp.asarray(self._rlens),
             jnp.asarray(self._strands), jnp.asarray(self._tstarts),
             jnp.asarray(self._tends),
-            self.tpl_f, self.trans_f, self.tpl_r, self.trans_r,
-            jnp.int32(L), self._W, fills_use_pallas(),
+            self.tpl_f, self.tpl_r, jnp.int32(L), self.trans_table,
+            self._W, fills_use_pallas(),
             guided_fill_passes(self._Jmax))
 
         ll_a = np.asarray(ll_a, np.float64)

@@ -410,31 +410,44 @@ def _halo_blocks(x, jm_pad: int):
     return jnp.concatenate([core, nxt], axis=2)
 
 
+def band_read_windows(reads, offsets, width: int):
+    """(rbase, rnext): every column's circular-lane read window for a flat
+    read batch — rbase[r, j, L] = read_pad1 value at the band row lane L
+    of column j holds (emission operand), rnext the read_pad0 value (the
+    insertion/link operand).  Built on the MXU via window_rows_circ; ONE
+    shared computation serves the interior kernel AND the edge programs
+    (_edge_read_windows slices it)."""
+    read_f = jax.vmap(lambda r: r.astype(jnp.float32))(reads)
+    from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
+
+    rbase = jax.vmap(lambda rf, o: window_rows_circ(
+        jnp.concatenate([rf[0:1], rf]), o, width))(read_f, offsets)
+    rnext = jax.vmap(lambda rf, o: window_rows_circ(rf, o, width))(
+        read_f, offsets)
+    return rbase, rnext
+
+
 @functools.partial(jax.jit, static_argnames=("width",))
 def dense_interior_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
                                 tables, alpha: BandedMatrix,
                                 beta: BandedMatrix, apre, bsuf, width: int,
-                                ptrans=None, live=None):
+                                ptrans=None, live=None, rwin=None):
     """(R, Jm, 9) window-frame interior scores for a flat read batch.
 
     reads (R, Imax) int; rlens (R,); win_tpl (R, Jm); win_trans (R, Jm, 4);
     wlens (R,); tables (R, 8, 4); alpha/beta batched banded fills on the
     unmutated windows; apre/bsuf (R, nc+1) scale prefixes.  Entry [r, p, k]
     is the absolute mutated-window log-likelihood of slot (p, k) for read
-    r, valid where the caller's interior classification holds."""
-    from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
-
+    r, valid where the caller's interior classification holds.  `rwin`:
+    precomputed band_read_windows (shared with the edge program)."""
     R, Imax = reads.shape
     Jm = win_tpl.shape[1]
     W = width
     nc = alpha.vals.shape[1]
     jm_pad = ((Jm + _PB - 1) // _PB) * _PB
 
-    read_f = jax.vmap(lambda r: r.astype(jnp.float32))(reads)
-    rbase = jax.vmap(lambda rf, o: window_rows_circ(
-        jnp.concatenate([rf[0:1], rf]), o, W))(read_f, alpha.offsets)
-    rnext = jax.vmap(lambda rf, o: window_rows_circ(rf, o, W))(
-        read_f, alpha.offsets)
+    rbase, rnext = rwin if rwin is not None else \
+        band_read_windows(reads, alpha.offsets, W)
 
     if ptrans is None:
         ptrans = jax.vmap(dense_patch_grids)(
@@ -539,26 +552,40 @@ _NE_MASK9 = np.array([[True] * 4 + [False] * 4 + [True],
                       [True] * 9])
 
 
-def _read_window_circ(read_pad, o, W: int):
-    """read_pad[circ_rows(o)[L]] via two contiguous dynamic slices + one
-    select (the circular window splits at the lane wrap); read_pad must
-    extend 2W past the largest start."""
-    o = jnp.asarray(o, jnp.int32)
-    q = o % W
-    b = o - q
-    s1 = lax.dynamic_slice(read_pad, (b,), (W,))
-    s2 = lax.dynamic_slice(read_pad, (b + W,), (W,))
-    L = jnp.arange(W, dtype=jnp.int32)
-    return jnp.where(L >= q, s1, s2)
+def _edge_read_windows(rbase, rnext, J, W: int):
+    """(R, 11, W) circular-lane read windows for the edge programs,
+    SLICED from the interior kernel's per-column window tensors (rbase =
+    read_pad1 windows at every column's band offset, rnext = read_pad0
+    windows; dense_interior_scores_batch builds both once per score
+    call on the MXU via window_rows_circ).
+
+    Rows 0-3: columns 1..4 (the near-begin refill columns); row 4: the
+    read_pad0 window at column 4's offset (the near-begin link row);
+    rows 5-10: columns J-3..J+2 (the near-end extension columns, offsets
+    clipped to the last column like the edge oracle's offs_pad).
+
+    The per-read dynamic slices these replace lowered to scalar-core
+    gathers under vmap — ~13% of all device time on the round-5 headline
+    profile; here the near-begin rows are STATIC slices and the near-end
+    rows one whole-row contiguous dynamic slice per read."""
+    wins_nb = rbase[:, 1:5]                                      # (R, 4, W)
+    rn4 = rnext[:, 4:5]                                          # (R, 1, W)
+    rbase_pad = jnp.concatenate(
+        [rbase, jnp.repeat(rbase[:, -1:], 2, axis=1)], axis=1)
+    wins_ne = jax.vmap(
+        lambda rb, j: lax.dynamic_slice(rb, (j - 3, 0), (6, W))
+    )(rbase_pad, J)                                              # (R, 6, W)
+    return jnp.concatenate([wins_nb, rn4, wins_ne], axis=1)
 
 
-def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
+def _edge_nb_read(wins, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
                   *, W: int):
     """Near-begin scores of one read: (27,) absolute LLs for slots at
     window positions {0, 1, 2} (rows of pt3).  Mirrors edge_scores_fast's
     near-begin branch: refill virtual DP columns 1..4 from the pinned
     start, LinkAlphaBeta at virtual column 4 against saved beta column
-    5 - ld."""
+    5 - ld.  `wins` are this read's precomputed circular read windows
+    (_edge_read_windows rows: 0-3 = columns 1..4, 4 = the link row)."""
     from pbccs_tpu.ops.mutation_score import (_circ_rows_batch, _ext_col,
                                               _in_band)
 
@@ -566,9 +593,6 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     hit, em_miss = 1.0 - eps, eps / 3.0
     M = 27
     tplf = tpl.astype(jnp.float32)
-    readf = read.astype(jnp.float32)
-    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(2 * W)])
-    read_pad0 = jnp.concatenate([readf, jnp.zeros(2 * W + 1)])
     maxl = J + jnp.asarray(_LD27, jnp.int32)
 
     # per-slot virtual template bases/trans at static absolute window
@@ -612,7 +636,7 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     o_prev = offs[0]
     for j in range(1, 5):
         o_j = offs[j]
-        rb_j = jnp.broadcast_to(_read_window_circ(read_pad1, o_j, W), (M, W))
+        rb_j = jnp.broadcast_to(wins[j - 1], (M, W))
         ext = one_col(ext, jnp.broadcast_to(o_prev, (M,)),
                       jnp.broadcast_to(o_j, (M,)), rb_j,
                       jnp.full((M,), j, jnp.int32),
@@ -626,7 +650,7 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     rows4 = _circ_rows_batch(jnp.broadcast_to(offs[4], (M,)), W)
     link_tr = vT(3)
     link_b = vB(4)
-    rn4 = jnp.broadcast_to(_read_window_circ(read_pad0, offs[4], W), (M, W))
+    rn4 = jnp.broadcast_to(wins[4], (M, W))
     em_link = jnp.where(rn4 == link_b[:, None], hit, em_miss)
     from pbccs_tpu.ops.fwdbwd import circ_roll
     beta_ip1 = jnp.where(_in_band(rows4 + 1, o_b, W),
@@ -639,13 +663,15 @@ def _edge_nb_read(read, I, tpl, trans, J, offs, bvals, boffs, bsuf, pt3,
     return jnp.log(jnp.maximum(v, _TINY)) + bsuf_b
 
 
-def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
+def _edge_ne_read(wins, I, tpl, trans, J, avals, offs, apre, ptrans,
                   *, W: int):
     """Near-end scores of one read: (27,) absolute LLs for slots at
     window positions {J-2, J-1, J}.  Mirrors edge_scores_fast's near-end
     branch: extend saved alpha columns s..s+2 through the pinned (I, J')
     corner; LL = log corner + alpha scale prefix.  Geometry is static in
     the J-relative frame, so every load is one contiguous dynamic slice.
+    `wins` are this read's precomputed circular read windows
+    (_edge_read_windows rows 5-10 = columns J-3..J+2).
     Caller guarantees J >= 8 (tiny windows bail to the host path)."""
     from pbccs_tpu.ops.mutation_score import _ext_col
 
@@ -654,8 +680,6 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
     M = 27
     nc = avals.shape[0]
     tplf = tpl.astype(jnp.float32)
-    readf = read.astype(jnp.float32)
-    read_pad1 = jnp.concatenate([readf[0:1], readf, jnp.zeros(2 * W)])
     maxl = J + jnp.asarray(_LD27, jnp.int32)
 
     # J-relative contiguous slices (padded so no dynamic_slice clamping)
@@ -668,8 +692,7 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
     transS = lax.dynamic_slice(
         jnp.concatenate([trans, jnp.zeros((3, 4))]), (J - 6, 0), (9, 4))
     ptS = lax.dynamic_slice(ptrans, (J - 2, 0, 0, 0), (3, 9, 2, 4))
-    rb6 = jnp.stack([_read_window_circ(read_pad1, offs7[i], W)
-                     for i in range(1, 7)])                  # cols J-3..J+2
+    rb6 = wins[5:11]                                         # cols J-3..J+2
 
     # t = s - (J-4) in {1..4}, static per slot (s = p - [k==del])
     t_np = _Q27 + 2 - _ISDEL27.astype(int)
@@ -746,19 +769,24 @@ def _edge_ne_read(read, I, tpl, trans, J, avals, offs, apre, ptrans,
 @functools.partial(jax.jit, static_argnames=("width",))
 def edge_window_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
                              alpha: BandedMatrix, beta: BandedMatrix,
-                             apre, bsuf, ptrans, width: int):
+                             apre, bsuf, ptrans, width: int, rwin=None):
     """(R, 6, 9) window-frame edge-slot scores: rows 0..2 = window
     positions {0, 1, 2} (near-begin), rows 3..5 = {J-2, J-1, J}
     (near-end).  Entries whose slot is actually interior (ins at J-2) or
-    invalid are garbage the caller masks/splices around."""
-    def one(read, I, tpl, trans, J, avals, aoffs, bvals, boffs, ap, bs, pt):
-        nb = _edge_nb_read(read, I, tpl, trans, J, aoffs, bvals, boffs,
+    invalid are garbage the caller masks/splices around.  `rwin`:
+    precomputed band_read_windows (shared with the interior kernel)."""
+    rbase, rnext = rwin if rwin is not None else \
+        band_read_windows(reads, alpha.offsets, width)
+    wins = _edge_read_windows(rbase, rnext, wlens.astype(jnp.int32), width)
+
+    def one(w11, I, tpl, trans, J, avals, aoffs, bvals, boffs, ap, bs, pt):
+        nb = _edge_nb_read(w11, I, tpl, trans, J, aoffs, bvals, boffs,
                            bs, pt[:3], W=width)
-        ne = _edge_ne_read(read, I, tpl, trans, J, avals, aoffs, ap, pt,
+        ne = _edge_ne_read(w11, I, tpl, trans, J, avals, aoffs, ap, pt,
                            W=width)
         return jnp.concatenate([nb.reshape(3, 9), ne.reshape(3, 9)])
 
-    return jax.vmap(one)(reads.astype(jnp.int32), rlens.astype(jnp.int32),
+    return jax.vmap(one)(wins, rlens.astype(jnp.int32),
                          win_tpl.astype(jnp.int32), win_trans,
                          wlens.astype(jnp.int32),
                          alpha.vals, alpha.offsets.astype(jnp.int32),

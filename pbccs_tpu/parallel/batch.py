@@ -112,8 +112,7 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
         trans_r = template_transition_params(tpl_r, table, L)
 
         win = jax.vmap(
-            lambda s, a, b: oriented_window(s, a, b, tpl, trans_f,
-                                            tpl_r, trans_r, L)
+            lambda s, a, b: oriented_window(s, a, b, tpl, tpl_r, L, table)
         )(st1, ts1, te1)
 
         mean_f, var_f = per_base_mean_and_variance(trans_f)

@@ -335,7 +335,7 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     whole grid then maps and reduces in one pass, with no packed edge
     slab, no edge budget, and no template-frame edge machinery."""
     from pbccs_tpu.ops.dense_score_pallas import (
-        dense_interior_scores_batch, dense_patch_grids,
+        band_read_windows, dense_interior_scores_batch, dense_patch_grids,
         edge_window_scores_batch, splice_edge_rows, window_grid_to_template)
 
     Z, R = reads.shape[:2]
@@ -386,9 +386,13 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
         pref, idx.reshape(Z, -1), axis=1).reshape(Z, R, NB)
     live = ((take(hi) - take(lo)) > 0) & real_rows[:, :, None] \
         & st.active[:, :, None]
+    # one shared per-column read-window computation serves the interior
+    # kernel and the edge program (the edge program's former per-read
+    # dynamic slices were ~13% of device time on the round-5 profile)
+    rwin = band_read_windows(f_reads, alpha_f.offsets, W)
     grid_w = dense_interior_scores_batch(
         f_reads, f_rlens, f_wt, f_wtr, f_wl, tables, alpha_f, beta_f,
-        f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB))
+        f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB), rwin)
 
     # edge slots always compute (not gated behind a cond): the edge
     # program has no data dependence on the kernel output, so XLA
@@ -396,7 +400,7 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     # rounds that don't need them
     e6 = edge_window_scores_batch(f_reads, f_rlens, f_wt, f_wtr, f_wl,
                                   alpha_f, beta_f, f_apre, f_bsuf,
-                                  ptrans, W)
+                                  ptrans, W, rwin)
     grid_w = jax.vmap(splice_edge_rows)(grid_w, e6, f_wl.astype(jnp.int32))
     mapped = jax.vmap(
         lambda g, s, a, b: window_grid_to_template(g, s, a, b, jmax)
@@ -675,8 +679,7 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
             t_r = revcomp_padded(t, L)
             trans_r = template_transition_params(t_r, tb, L)
             win = jax.vmap(
-                lambda s, a, b: oriented_window(s, a, b, t, trans_f,
-                                                t_r, trans_r, L)
+                lambda s, a, b: oriented_window(s, a, b, t, t_r, L, tb)
             )(st1, ts1, te1)
             return win + (trans_f, t_r, trans_r)
 

@@ -62,8 +62,7 @@ def _setup_case(rng, L, n_reads, windows):
         st[i], ts_a[i], te_a[i] = strand, ts, te
 
     win = jax.vmap(
-        lambda s, a, b: oriented_window(s, a, b, tpl_p, trans_f,
-                                        tpl_r, trans_r, tlen)
+        lambda s, a, b: oriented_window(s, a, b, tpl_p, tpl_r, tlen, table)
     )(jnp.asarray(st), jnp.asarray(ts_a), jnp.asarray(te_a))
     win_tpl, win_trans, wlens = win
     alpha, beta, ll_a, ll_b, apre, bsuf = fill_alpha_beta_batch(
