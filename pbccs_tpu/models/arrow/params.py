@@ -248,6 +248,24 @@ def context_index(cur_base: jax.Array, next_base: jax.Array) -> jax.Array:
     return next_base + 4 * (cur_base != next_base).astype(next_base.dtype)
 
 
+def transition_lookup(cur_base: jax.Array, next_base: jax.Array,
+                      table: jax.Array) -> jax.Array:
+    """(..., 4) transition rows for dinucleotide contexts, as a one-hot
+    matmul on the MXU — the gather form (table[ctx]) lowers to the TPU
+    scalar core.  Single source of truth for the clip bounds / dtype /
+    precision flags (oriented_window and dense_patch_grids both ride it;
+    eager-vs-jit table evaluation drift caused a ~0.1-nat parity bug
+    once)."""
+    idx = jnp.clip(context_index(cur_base.astype(jnp.int32),
+                                 next_base.astype(jnp.int32)), 0, 7)
+    onehot = (idx[..., None] == jnp.arange(8)).astype(jnp.float32)
+    return jax.lax.dot_general(
+        onehot, table.astype(jnp.float32),
+        (((onehot.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+
+
 def template_transition_params(
     tpl: jax.Array, trans_table: jax.Array, length: jax.Array | None = None
 ) -> jax.Array:

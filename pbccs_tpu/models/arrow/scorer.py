@@ -26,11 +26,11 @@ from pbccs_tpu.models.arrow import mutations as mutlib
 from pbccs_tpu.models.arrow.expectations import per_base_mean_and_variance
 from pbccs_tpu.models.arrow.params import (
     ArrowConfig,
-    context_index,
     effective_band_width,
     revcomp,
     snr_to_transition_table_host,
     template_transition_params,
+    transition_lookup,
 )
 from pbccs_tpu.ops.fwdbwd import (
     backward_loglik,
@@ -95,11 +95,7 @@ def oriented_window(strand, ts, te, tpl_f, tpl_r, L, table):
     base = both[jnp.where(strand == 0, 0, Jmax) + src]
     win_tpl = jnp.where(idx < wlen, base, 4).astype(jnp.int8)
     w32 = win_tpl.astype(jnp.int32)
-    ctx = jnp.clip(context_index(w32, jnp.roll(w32, -1)), 0, 7)
-    onehot = (ctx[:, None] == jnp.arange(8)).astype(jnp.float32)
-    params = jax.lax.dot(onehot, table.astype(jnp.float32),
-                         preferred_element_type=jnp.float32,
-                         precision=jax.lax.Precision.HIGHEST)
+    params = transition_lookup(w32, jnp.roll(w32, -1), table)
     win_trans = jnp.where((idx < wlen - 1)[:, None], params, 0.0)
     return win_tpl, win_trans, wlen
 

@@ -52,7 +52,7 @@ from pbccs_tpu.models.arrow.params import (
     TRANS_DARK,
     TRANS_MATCH,
     TRANS_STICK,
-    context_index,
+    transition_lookup,
 )
 from pbccs_tpu.ops.fwdbwd import (BandedMatrix, _affine_scan_circ,
                                   circ_roll, circ_rows)
@@ -161,11 +161,7 @@ def dense_patch_grids(win_tpl, win_trans, table, wl):
     trans_p1 = _shift_pos(win_trans, 1)
 
     def T(a, b):
-        idx = jnp.clip(context_index(a, b), 0, 7)
-        oh = (idx[:, None] == jnp.arange(8)).astype(jnp.float32)
-        return jax.lax.dot(oh, table.astype(jnp.float32),
-                           preferred_element_type=jnp.float32,
-                           precision=jax.lax.Precision.HIGHEST)
+        return transition_lookup(a, b, table)
 
     zeros4 = jnp.zeros((Jm, 4), jnp.float32)
     gate = lambda cond, v: jnp.where(cond[:, None], v, zeros4)
@@ -414,16 +410,34 @@ def band_read_windows(reads, offsets, width: int):
     """(rbase, rnext): every column's circular-lane read window for a flat
     read batch — rbase[r, j, L] = read_pad1 value at the band row lane L
     of column j holds (emission operand), rnext the read_pad0 value (the
-    insertion/link operand).  Built on the MXU via window_rows_circ; ONE
-    shared computation serves the interior kernel AND the edge programs
-    (_edge_read_windows slices it)."""
+    insertion/link operand).  ONE shared computation serves the interior
+    kernel AND the edge programs (_edge_read_windows slices it).
+
+    Only rnext rides the one-hot window matmul; rbase derives from it:
+    rbase[j][L] = read_pad0[rows_j[L] - 1], and because circular lanes
+    are column-independent (lane = row mod W), that value is
+    circ_roll(rnext[j], 1) at every lane except the band's FIRST row
+    (the cut lane o_j % W), whose operand row o_j - 1 lives in column
+    j-1's window at the same rolled lane.
+
+    Safety of the remaining garbage lanes: when o_j == o_{j-1} (flat
+    offsets are routine) the cut-lane derivation returns rf[o_j + W - 1]
+    instead of rf[o_j - 1] — but every consumer masks exactly that
+    contribution: the cut lane's row is the band's first row, whose
+    match operand is gated by in_band(rows - 1, o_prev) (ext_b /
+    mutation_score._ext_col) and whose insertion operand by
+    rows > o_col (cmask), and rows outside [1, I] are masked by in_read.
+    Any new consumer of rbase must preserve those gates.
+    This halves the (nc, N) one-hot build + MXU windowing cost."""
     read_f = jax.vmap(lambda r: r.astype(jnp.float32))(reads)
     from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
 
-    rbase = jax.vmap(lambda rf, o: window_rows_circ(
-        jnp.concatenate([rf[0:1], rf]), o, width))(read_f, offsets)
     rnext = jax.vmap(lambda rf, o: window_rows_circ(rf, o, width))(
         read_f, offsets)
+    prev_col = jnp.concatenate([rnext[:, :1], rnext[:, :-1]], axis=1)
+    lane = jnp.arange(width, dtype=jnp.int32)
+    cut = (offsets.astype(jnp.int32) % width)[:, :, None] == lane
+    rbase = jnp.where(cut, circ_roll(prev_col, 1), circ_roll(rnext, 1))
     return rbase, rnext
 
 
@@ -796,11 +810,18 @@ def edge_window_scores_batch(reads, rlens, win_tpl, win_trans, wlens,
 
 def splice_edge_rows(grid, e6, J):
     """Overwrite one read's window-frame grid rows {0,1,2, J-2,J-1,J}
-    with the edge scores (ins at J-2 keeps its interior-kernel value)."""
-    grid = lax.dynamic_update_slice(grid, e6[:3], (0, 0))
-    cur = lax.dynamic_slice(grid, (J - 2, 0), (3, 9))
-    upd = jnp.where(jnp.asarray(_NE_MASK9), e6[3:], cur)
-    return lax.dynamic_update_slice(grid, upd, (J - 2, 0))
+    with the edge scores (ins at J-2 keeps its interior-kernel value).
+
+    Pure masked selects: the per-read dynamic_update_slices this replaces
+    lowered to vmapped scatters (~3k per round, ~2% of device time)."""
+    Jm = grid.shape[0]
+    pos = jnp.arange(Jm, dtype=jnp.int32)[:, None]                # (Jm, 1)
+    out = jnp.where(pos < 3, jnp.pad(e6[:3], ((0, Jm - 3), (0, 0))), grid)
+    ne_mask = jnp.asarray(_NE_MASK9)
+    for i in range(3):
+        row = jnp.broadcast_to(e6[3 + i], (Jm, 9))
+        out = jnp.where((pos == J - 2 + i) & ne_mask[i], row, out)
+    return out
 
 
 # --------------------------------------------------------------------------

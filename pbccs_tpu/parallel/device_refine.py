@@ -382,10 +382,25 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     fwd3 = strands[:, :, None] == 0
     lo = jnp.clip(jnp.where(fwd3, lo_f, lo_r) - 1, 0, jmax)
     hi = jnp.clip(jnp.where(fwd3, hi_f, hi_r) + 1, 0, jmax)
-    take = lambda idx: jnp.take_along_axis(
-        pref, idx.reshape(Z, -1), axis=1).reshape(Z, R, NB)
-    live = ((take(hi) - take(lo)) > 0) & real_rows[:, :, None] \
-        & st.active[:, :, None]
+    # pref[hi] - pref[lo]: below the size gate, ONE one-hot einsum on the
+    # MXU (the take_along_axis pair lowers to the scalar core, ~4% of
+    # device time at the headline config); the einsum's (Z, R*NB, jmax+1)
+    # selector is O(jmax) larger than the gathers, so long-template
+    # buckets keep the gather form.
+    if Z * R * NB * (jmax + 1) <= (1 << 26):
+        grid_pos = jnp.arange(jmax + 1, dtype=jnp.int32)
+        sel = ((hi.reshape(Z, -1, 1) == grid_pos).astype(jnp.float32)
+               - (lo.reshape(Z, -1, 1) == grid_pos).astype(jnp.float32))
+        diff = jnp.einsum("zmn,zn->zm", sel, pref.astype(jnp.float32),
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST
+                          ).reshape(Z, R, NB)
+        live = diff > 0.5
+    else:
+        take = lambda idx: jnp.take_along_axis(
+            pref, idx.reshape(Z, -1), axis=1).reshape(Z, R, NB)
+        live = (take(hi) - take(lo)) > 0
+    live = live & real_rows[:, :, None] & st.active[:, :, None]
     # one shared per-column read-window computation serves the interior
     # kernel and the edge program (the edge program's former per-read
     # dynamic slices were ~13% of device time on the round-5 profile)
