@@ -68,10 +68,18 @@ def build_tasks(rng, n_zmws: int, tpl_len: int, n_passes, n_corruptions: int):
 def _refine_opts():
     """The bench's refinement options — shared by the timed workload and
     the straggler-shape warmup (max_iterations is an executable cache
-    key, so both must agree)."""
+    key, so both must agree).
+
+    Defaults (max_iterations=40) MATCH the reference
+    (ConsensusCore Consensus.hpp:57 MaximumIterations = 40, what
+    native/refbench runs): the old pinned 10 was invisible at short
+    templates (3-5 rounds to converge) but starved the 15 kb config,
+    whose ZMWs legitimately apply mutations for 15-25 rounds — they were
+    reported non-converged at budget and then paid host-side
+    continuation compiles that buried the device loop's actual speed."""
     from pbccs_tpu.models.arrow.refine import RefineOptions
 
-    return RefineOptions(max_iterations=10)
+    return RefineOptions()
 
 
 def run_workload(tasks):
@@ -319,13 +327,11 @@ SWEEP_CONFIGS = [
     # OOMed the shared HBM at larger batches
     ("cfg2_2kb_3-10p", 128, 2000, "3-10", 2, 32, 1, {}),
     ("cfg4_30px500bp", 64, 500, "30", 2, 64, 3, {}),
-    # 15 kb runs the HOST refinement loop with chunked device scoring:
-    # the device-resident loop / dense-kernel programs at this bucket
-    # never finish compiling through the remote compile helper
-    # (docs/PROFILE_r04.md); the host-loop operating point is host-bound
-    # but measures well above the reference C++ on the identical workload
-    ("cfg3_15kb_3p", 4, 15000, "3", 2, 4, 3,
-     {"PBCCS_DEVICE_REFINE": "0", "PBCCS_DENSE": "0"}),
+    # 15 kb runs DEVICE-RESIDENT since the circular-lane kernels: the
+    # round-4 compile wall (>40 min, PROFILE_r04) is gone (~2 min cold,
+    # persistent-cached after), and the warm loop runs the whole 15 kb
+    # refinement on the chip (~0.5 s/round at this bucket)
+    ("cfg3_15kb_3p", 4, 15000, "3", 2, 4, 3, {}),
 ]
 
 

@@ -172,6 +172,14 @@ def effective_band_width(banding: "BandingOptions", jmax: int) -> int:
     for, even under the env override); PBCCS_BAND_W replaces the
     schedule's default choice only.
 
+    Long buckets (> 8192) run W=128: at 15 kb the alignment drift after a
+    big apply round clips the W=96 band even with guided rebanding — one
+    read unmates at the round-1 rebuild and the ZMW runs away on weak
+    evidence (grew +834 bases and overflowed the bucket on the round-5
+    bench draw; W=128 keeps every read mated, 4/4 converge).  Band lanes
+    below the 128-lane VPU width are padding anyway, so the extra width
+    costs only VMEM and window matmuls, not vector throughput.
+
     The reference's analogue is the adaptive per-column band itself
     (SimpleRecursor.cpp:693-757), which sizes effort to the data; a static
     schedule keyed on the compile-time bucket is the XLA-friendly form."""
@@ -180,7 +188,9 @@ def effective_band_width(banding: "BandingOptions", jmax: int) -> int:
     env = os.environ.get("PBCCS_BAND_W")
     if env:
         return int(env)
-    return 64 if jmax <= 576 else 96
+    if jmax <= 576:
+        return 64
+    return 96 if jmax <= 8192 else 128
 
 
 @dataclasses.dataclass(frozen=True)

@@ -10,9 +10,11 @@ axis, so sharding reads across the 'read' mesh axis makes XLA insert the
 all-reduce; the ZMW axis is pure data parallelism.
 
 Selection semantics per ZMW are identical to the host refinement loop
-(models/arrow/refine.py): favorable = score > 0, greedy well-separated best
-subset, template-hash cycle avoidance, converged ZMWs drop out of the
-mutation workload (their slots are masked, not recompiled away).
+(models/arrow/refine.py): favorable = score above the f32 noise floor
+(refine.favorability_threshold; the reference's `score > 0` in f64),
+greedy well-separated best subset, template-hash cycle avoidance,
+converged ZMWs drop out of the mutation workload (their slots are
+masked, not recompiled away).
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ from pbccs_tpu.models.arrow.params import (
     snr_to_transition_table_host,
     template_transition_params,
 )
+from pbccs_tpu.models.arrow import refine as refine_mod
 from pbccs_tpu.models.arrow.refine import RefineOptions, RefineResult
 from pbccs_tpu.models.arrow.scorer import (
     ADD_ALPHABETAMISMATCH,
@@ -411,8 +414,15 @@ class BatchPolisher:
         rq = mesh.shape[READ_AXIS] if mesh else 1
         self._Z = pad_to(max(self.n_zmws, min_z), zq)
         self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
-        self._Imax = pad_to(max((len(r) for t in tasks for r in t.reads),
-                                default=8) + 8, 64)
+        # read-axis bucket granularity scales with length (~1/8th,
+        # power-of-two steps, floor 64): long-read workloads draw max
+        # read lengths that differ by hundreds of bases run to run, and a
+        # fixed 64-step bucket minted a fresh executable set per draw —
+        # a ~90 s recompile inside every timed 15 kb repeat
+        raw_imax = max((len(r) for t in tasks for r in t.reads),
+                       default=8) + 8
+        step = max(64, 1 << max(raw_imax - 1, 1).bit_length() - 3)
+        self._Imax = pad_to(raw_imax, step)
         max_l = max(len(t.tpl) for t in tasks)
         self._Jmax = _jmax_bucket(max_l)
         if buckets is not None:
@@ -1262,6 +1272,13 @@ class BatchPolisher:
                 break
             scores = self.score_mutation_arrays(arrs)
 
+            # f32 score-noise floor, same rule as the device loop and the
+            # per-ZMW host loop (models/arrow/refine.py: sub-noise deltas
+            # at long templates read favorable in BOTH directions of an
+            # ins/del pair and ping-pong the loop to its budget)
+            eps_z = refine_mod.favorability_threshold(
+                np.where(self.active, np.abs(self.baselines), 0.0).sum(1))
+
             best_per_zmw: list[list[mutlib.Mutation]] = []
             for z in range(Z):
                 if done[z]:
@@ -1269,7 +1286,7 @@ class BatchPolisher:
                     continue
                 results[z].iterations = it + 1
                 results[z].n_tested += arrs[z].size
-                favi = np.nonzero(scores[z] > 0.0)[0]
+                favi = np.nonzero(scores[z] > eps_z[z])[0]
                 fav = arrs[z].take(favi).to_mutations(scores[z][favi])
                 favorable[z] = fav
                 if not fav:

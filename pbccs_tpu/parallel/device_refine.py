@@ -729,7 +729,14 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         # 2. scores
         totals, fb_any = score_all(st, start, end, mtype, base, valid)
         scores = jnp.where(valid, totals, -jnp.inf)
-        favorable = valid & (scores > 0.0)
+        # favorability above the f32 score-noise floor (one source of
+        # truth: refine.favorability_threshold) -- sub-noise deltas at
+        # long templates read "favorable" in BOTH directions of an
+        # ins/del pair and ping-pong the loop to its budget
+        from pbccs_tpu.models.arrow.refine import favorability_threshold
+        eps_z = favorability_threshold(jnp.sum(
+            jnp.where(st.active, jnp.abs(st.baselines), 0.0), axis=1))
+        favorable = valid & (scores > eps_z[:, None])
         fav_any = favorable.any(axis=1)
 
         iterations = st.iterations + (~st.done).astype(jnp.int32)

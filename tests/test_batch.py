@@ -111,14 +111,23 @@ def test_batch_sharded_pallas_fills(rng, monkeypatch):
 
 
 @pytest.mark.slow
-def test_batch_sharded_device_refine_matches_unsharded(rng, monkeypatch):
+@pytest.mark.parametrize("tpl_len", [60, 300])
+def test_batch_sharded_device_refine_matches_unsharded(rng, monkeypatch,
+                                                       tpl_len):
     """The sharded device-resident refinement loop (shard_map over the
     ('zmw', 'read') mesh with read-axis psum) produces the same templates,
-    refine stats, and QVs as the single-device device loop."""
+    refine stats, and QVs as the single-device device loop.
+
+    tpl_len=300 runs a multi-block (NB=6) bucket so the mesh path covers
+    the halo-block streaming, the W(L) schedule, and the live-mask einsum
+    the 60 bp bucket doesn't reach — multi-chip long-insert runs take
+    this same sharded dense path (dense_score_enabled up to
+    DENSE_MAX_JMAX; the mesh bail at parallel/batch.py only triggers
+    beyond it)."""
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
     from pbccs_tpu.models.arrow.refine import RefineOptions
 
-    tasks, _ = make_tasks(rng, n_zmws=4, tpl_len=60, n_passes=4)
+    tasks, _ = make_tasks(rng, n_zmws=4, tpl_len=tpl_len, n_passes=4)
     for t in tasks:  # corrupt drafts so refinement has real work
         t.tpl[30] = (t.tpl[30] + 1) % 4
     opts = RefineOptions(max_iterations=6)
