@@ -271,7 +271,7 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
     # fewer/larger chunks lose overlap granularity (32 measured best of
     # {32, 64, 128} at Z=128, so the chunk SIZE is pinned and the chunk
     # count scales with the workload)
-    chunk = 32
+    chunk = int(os.environ.get("BENCH_E2E_CHUNK", 32))
     argv = [out, fasta, "--skipChemistryCheck",
             "--chunkSize", str(chunk), "--numThreads", "3", "--zmws", "all",
             "--reportFile", os.path.join(tmp, "ccs_report.csv")]

@@ -1257,6 +1257,15 @@ class BatchPolisher:
         for z in (skip or ()):
             done[z] = True
 
+        # f32 score-noise floor, same rule as the device loop and the
+        # per-ZMW host loop (models/arrow/refine.py).  eps is a NOISE
+        # SCALE, not a semantic quantity: computed ONCE from the
+        # AddRead-time magnitudes (one stats fetch, not one per round);
+        # round-over-round drift of sum |baseline| is percent-level and
+        # immaterial to a rounding-error threshold.
+        eps_z = refine_mod.favorability_threshold(
+            np.where(self.active, np.abs(self.baselines), 0.0).sum(1))
+
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         for it in range(budget):
             arrs: list[mutlib.MutationArrays] = []
@@ -1271,13 +1280,6 @@ class BatchPolisher:
             if all(done):
                 break
             scores = self.score_mutation_arrays(arrs)
-
-            # f32 score-noise floor, same rule as the device loop and the
-            # per-ZMW host loop (models/arrow/refine.py: sub-noise deltas
-            # at long templates read favorable in BOTH directions of an
-            # ins/del pair and ping-pong the loop to its budget)
-            eps_z = refine_mod.favorability_threshold(
-                np.where(self.active, np.abs(self.baselines), 0.0).sum(1))
 
             best_per_zmw: list[list[mutlib.Mutation]] = []
             for z in range(Z):
