@@ -116,6 +116,10 @@ def test_greedy_peel_matches_scan(rng):
         want = np.asarray(dr.greedy_well_separated_scan(a, st, f, sep, jmax))
         np.testing.assert_array_equal(got, want,
                                       err_msg=f"trial={trial} sep={sep}")
+        # the position-major fast form (what the loop body runs) agrees
+        posm = np.asarray(dr.greedy_well_separated_posmajor(a, f, sep, jmax))
+        np.testing.assert_array_equal(posm, want,
+                                      err_msg=f"posmajor trial={trial}")
 
 
 def test_splice_matches_apply_mutations(rng):
