@@ -50,12 +50,10 @@ DESCRIPTION = ("Generate circular consensus sequences (ccs) from subreads "
 FASTA_EXTS = (".fa", ".fasta", ".fsa", ".fa.gz", ".fasta.gz", ".fsa.gz")
 
 
-def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="ccs", description=DESCRIPTION)
-    p.add_argument("--version", action="version", version=__version__)
-    p.add_argument("--zmws", default="all",
-                   help="ZMWs to process: all, or ranges like 1-3,5 or "
-                        "movie:1-3,5;movie2:*. Default = %(default)s")
+def add_consensus_args(p: argparse.ArgumentParser) -> None:
+    """The consensus-gate flags shared verbatim by `ccs` and `ccs serve`
+    (serve.server.build_serve_parser): one definition, one set of
+    defaults, so the two drivers cannot desynchronize."""
     p.add_argument("--minSnr", type=float, default=4.0,
                    help="Minimum SNR of input subreads. Default = %(default)s")
     p.add_argument("--minReadScore", type=float, default=0.75,
@@ -70,6 +68,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Minimum subread z-score; NaN disables. Default = %(default)s")
     p.add_argument("--maxDropFraction", type=float, default=0.34,
                    help="Maximum fraction of droppable subreads. Default = %(default)s")
+    p.add_argument("--model", choices=("arrow", "quiver"), default="arrow",
+                   help="Polish model family (default: arrow, the ccs "
+                        "model; quiver is the QV-feature model -- reads "
+                        "without QV tracks use flat default tracks).")
+
+
+def consensus_settings_from_args(args) -> ConsensusSettings:
+    return ConsensusSettings(
+        min_length=args.minLength,
+        min_passes=args.minPasses,
+        min_snr=args.minSnr,
+        min_predicted_accuracy=args.minPredictedAccuracy,
+        min_zscore=args.minZScore,
+        max_drop_fraction=args.maxDropFraction,
+        model=args.model)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ccs", description=DESCRIPTION,
+        epilog="`ccs serve [OPTIONS]` starts the long-lived online serving "
+               "engine instead (see `ccs serve --help`).")
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--zmws", default="all",
+                   help="ZMWs to process: all, or ranges like 1-3,5 or "
+                        "movie:1-3,5;movie2:*. Default = %(default)s")
+    add_consensus_args(p)
     p.add_argument("--numThreads", type=int, default=0,
                    help="Number of host pipeline threads (0 = auto). "
                         "Default = %(default)s")
@@ -81,10 +106,6 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TRACE..FATAL. Default = %(default)s")
     p.add_argument("--reportFile", default="ccs_report.csv",
                    help="Where to write the yield report. Default = %(default)s")
-    p.add_argument("--model", choices=("arrow", "quiver"), default="arrow",
-                   help="Polish model family (default: arrow, the ccs "
-                        "model; quiver is the QV-feature model -- reads "
-                        "without QV tracks use flat default tracks).")
     p.add_argument("--skipChemistryCheck", action="store_true",
                    help="Accept non-P6-C4 read groups (required for FASTA "
                         "input, which carries no chemistry metadata).")
@@ -186,6 +207,12 @@ def _chunks_from_files(files, whitelist: Whitelist, args, log,
 
 
 def run(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # `ccs serve`: the long-lived online engine (pbccs_tpu/serve/)
+        from pbccs_tpu.serve.server import run_serve
+
+        return run_serve(argv[1:])
     args = build_parser().parse_args(argv)
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
@@ -203,14 +230,7 @@ def run(argv: list[str] | None = None) -> int:
         print(f"option --zmws: invalid specification: {e}", file=sys.stderr)
         return 2
 
-    settings = ConsensusSettings(
-        min_length=args.minLength,
-        min_passes=args.minPasses,
-        min_snr=args.minSnr,
-        min_predicted_accuracy=args.minPredictedAccuracy,
-        min_zscore=args.minZScore,
-        max_drop_fraction=args.maxDropFraction,
-        model=args.model)
+    settings = consensus_settings_from_args(args)
 
     files = flatten_fofn(args.files)
     for f in files:
@@ -253,7 +273,25 @@ def run(argv: list[str] | None = None) -> int:
 
     from pbccs_tpu.runtime import timing
 
+    # The work queue's max_pending bounds results not yet CONSUMED, so the
+    # consumer must run concurrently with the produce loop (the reference's
+    # reader/worker/writer overlap, ccs.cpp:388-499) -- a produce-everything-
+    # then-consume loop would deadlock once the pipeline fills.
+    import threading
+
+    consumed = ResultTally()
+    consumer_error: list[BaseException] = []
+
     with WorkQueue(n_threads) as wq:
+        def _consume():
+            try:
+                for sub_tally in wq.results():
+                    consumed.merge(sub_tally)
+            except BaseException as e:  # noqa: BLE001 -- re-raised below
+                consumer_error.append(e)
+
+        consumer = threading.Thread(target=_consume, name="pbccs-consumer")
+        consumer.start()
         it = iter(_chunks_from_files(files, whitelist, args, log, tally))
         while True:
             with timing.stage("read"):
@@ -266,8 +304,10 @@ def run(argv: list[str] | None = None) -> int:
             with timing.stage("queue"):
                 wq.produce(process_chunks, batch, settings)
         wq.finalize()
-        for sub_tally in wq.results():
-            tally.merge(sub_tally)
+        consumer.join()
+    if consumer_error:
+        raise consumer_error[0]
+    tally.merge(consumed)
 
     log.info(f"processed {tally.total} ZMWs: "
              f"{tally.counts[Failure.SUCCESS]} successes")

@@ -69,6 +69,17 @@ from pbccs_tpu.ops.fwdbwd import (MAX_BAND_ADVANCE, BandedMatrix,
                                   band_offsets, circ_roll, circ_rows,
                                   in_band)
 
+def tpu_compiler_params(**kwargs):
+    """Version-compat shim for the Mosaic compiler-params dataclass: newer
+    JAX names it pltpu.CompilerParams, this pin (0.4.x) calls it
+    TPUCompilerParams.  Shared by every Pallas fill site (the Arrow
+    forward/backward scan here and the Quiver fill, which routes through
+    _fill below)."""
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 _TINY = 1e-30
 # band may advance at most this many rows per column; single source of
 # truth lives in fwdbwd (guided_band_offsets clamps its slope to it)
@@ -470,7 +481,7 @@ def _run_fill(cm, cd, cc, mask, seed, seedcol, rev_store: bool,
             jax.ShapeDtypeStruct((nc, R, 1), jnp.float32),
         ],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(*operands)

@@ -82,6 +82,25 @@ def _jmax_bucket(max_len: int) -> int:
     return pad_to(max_len + max(16, max_len // 32), 64)
 
 
+def _imax_bucket(raw_imax: int) -> int:
+    """Read-axis bucket: granularity scales with length (~1/8th,
+    power-of-two steps, floor 64): long-read workloads draw max read
+    lengths that differ by hundreds of bases run to run, and a fixed
+    64-step bucket minted a fresh executable set per draw -- a ~90 s
+    recompile inside every timed 15 kb repeat."""
+    step = max(64, 1 << max(raw_imax - 1, 1).bit_length() - 3)
+    return pad_to(raw_imax, step)
+
+
+def length_bucket(tpl_len: int, max_read_len: int) -> tuple[int, int]:
+    """The (Jmax, Imax) compiled-shape bucket a ZMW of this geometry
+    polishes in -- the grouping key of the serving engine's dynamic
+    batcher (pbccs_tpu.serve.batcher): ZMWs that share a bucket share
+    every compiled polish program, so batching within a bucket never
+    mints new executables."""
+    return _jmax_bucket(tpl_len), _imax_bucket(max_read_len + 8)
+
+
 @dataclasses.dataclass
 class ZmwTask:
     """One ZMW's polish-stage inputs (draft template + mapped reads)."""
@@ -414,15 +433,9 @@ class BatchPolisher:
         rq = mesh.shape[READ_AXIS] if mesh else 1
         self._Z = pad_to(max(self.n_zmws, min_z), zq)
         self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
-        # read-axis bucket granularity scales with length (~1/8th,
-        # power-of-two steps, floor 64): long-read workloads draw max
-        # read lengths that differ by hundreds of bases run to run, and a
-        # fixed 64-step bucket minted a fresh executable set per draw —
-        # a ~90 s recompile inside every timed 15 kb repeat
         raw_imax = max((len(r) for t in tasks for r in t.reads),
                        default=8) + 8
-        step = max(64, 1 << max(raw_imax - 1, 1).bit_length() - 3)
-        self._Imax = pad_to(raw_imax, step)
+        self._Imax = _imax_bucket(raw_imax)
         max_l = max(len(t.tpl) for t in tasks)
         self._Jmax = _jmax_bucket(max_l)
         if buckets is not None:

@@ -917,9 +917,11 @@ def _sharded_loop_fn(mesh, zmw_axis: str, read_axis: str,
 
     specs = _state_specs(zmw_axis, read_axis)
     zr, z = P(zmw_axis, read_axis), P(zmw_axis)
+    from pbccs_tpu.parallel.mesh import shard_map
+
     f = functools.partial(run_refine_loop.__wrapped__,
                           axis=(zmw_axis, read_axis), **dict(statics))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(specs, zr, zr, zr, z, zr),
         out_specs=specs, check_vma=False))
@@ -931,9 +933,11 @@ def _sharded_qv_fn(mesh, zmw_axis: str, read_axis: str, statics: tuple):
 
     specs = _state_specs(zmw_axis, read_axis)
     zr, z = P(zmw_axis, read_axis), P(zmw_axis)
+    from pbccs_tpu.parallel.mesh import shard_map
+
     f = functools.partial(run_qv_ints.__wrapped__,
                           axis=(zmw_axis, read_axis), **dict(statics))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(specs, zr, zr, zr, z, zr, z),
         out_specs=(z, P()), check_vma=False))

@@ -433,51 +433,40 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
     return polish_prepared(prep, settings)
 
 
-def process_chunks(chunks: Sequence[Chunk],
-                   settings: ConsensusSettings | None = None,
-                   batch_polish: bool = True) -> ResultTally:
-    """Process a batch of ZMWs; exceptions become Other tallies and the batch
-    continues (reference Consensus.h:543-548).
+def polish_prepared_batch(preps: Sequence[PreparedZmw],
+                          settings: ConsensusSettings | None = None, *,
+                          buckets: tuple[int, int, int] | None = None,
+                          min_z: int = 1
+                          ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """Polish a batch of prepared ZMWs in one lockstep BatchPolisher and
+    return per-ZMW outcomes ALIGNED with `preps` -- the polish core shared
+    by the offline driver (process_chunks) and the serving engine
+    (pbccs_tpu.serve.engine.CcsEngine), which needs to route each outcome
+    back to the client that submitted it.
 
-    With batch_polish (the default), all ZMWs that survive the host stages
-    polish together in one lockstep BatchPolisher -- the TPU execution model
-    (one batched device program per refinement round) instead of the
-    reference's one-thread-per-ZMW loop.  Any polish-stage error falls back
-    to the serial per-ZMW path to preserve fault isolation."""
+    `buckets`/`min_z` pin the BatchPolisher's (Imax, Jmax, R)/Z shapes to
+    caller-chosen lower bounds: the serving engine pins them to its length
+    bucket + pow2 sizes so variable-size online flushes reuse one bounded
+    compiled-program menu instead of minting a fresh device loop per
+    (batch size, read count) draw.
+
+    Any batch-path error falls back to the serial per-ZMW pipeline (fault
+    isolation, reference Consensus.h:543-548); a ZMW that fails even there
+    reports Failure.OTHER rather than poisoning its batch."""
     settings = settings or ConsensusSettings()
-    tally = ResultTally()
-    # the lockstep BatchPolisher is the Arrow device path; Quiver polishes
-    # through the per-ZMW pipeline (its scorer batches fills internally)
-    if not batch_polish or settings.model == "quiver":
-        for chunk in chunks:
+    if settings.model == "quiver":
+        # Quiver has no lockstep batch driver: it polishes per ZMW (its
+        # scorer batches fills internally), with the same fault isolation
+        out: list[tuple[Failure, ConsensusResult | None]] = []
+        for p in preps:
             try:
-                failure, result = process_chunk(chunk, settings)
+                out.append(polish_prepared(p, settings))
             except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
-                tally.tally(Failure.OTHER)
-                continue
-            tally.tally(failure)
-            if result is not None:
-                tally.results.append(result)
-        return tally
-
-    from pbccs_tpu.runtime import timing
-
-    preps: list[PreparedZmw] = []
-    with timing.stage("draft"):
-        for chunk in chunks:
-            try:
-                failure, prep = prepare_chunk(chunk, settings)
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
-                tally.tally(Failure.OTHER)
-                continue
-            if failure is not None:
-                tally.tally(failure)
-            else:
-                preps.append(prep)
-    if not preps:
-        return tally
-
+                out.append((Failure.OTHER, None))
+        return out
     try:
+        from pbccs_tpu.runtime import timing
+
         t0 = time.monotonic()
         from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
 
@@ -486,7 +475,8 @@ def process_chunks(chunks: Sequence[Chunk],
                          [m.strand for m in p.mapped],
                          [m.tpl_start for m in p.mapped],
                          [m.tpl_end for m in p.mapped]) for p in preps]
-        polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore)
+        polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
+                                 buckets=buckets, min_z=min_z)
         gate_info = []
         for z, p in enumerate(preps):
             gate_info.append(_read_gates(p, polisher.statuses[z], settings))
@@ -601,13 +591,13 @@ def process_chunks(chunks: Sequence[Chunk],
         timing.add_stage("polish", polish_s)
         polish_ms = polish_s * 1e3 / max(len(preps), 1)
 
-        # tallies accumulate into a local batch tally so a mid-loop fault
-        # cannot double-count ZMWs when the serial fallback reruns them
-        bt = ResultTally()
+        # outcomes accumulate into a local list so a mid-loop fault cannot
+        # double-count ZMWs when the serial fallback reruns them
+        outcomes: list[tuple[Failure, ConsensusResult | None]] = []
         for z, p in enumerate(preps):
             failure, status_counts, n_passes = gate_info[z]
             if failure is not None:
-                bt.tally(failure)
+                outcomes.append((failure, None))
                 continue
             nr = len(p.mapped)
             if z in wide_pick:
@@ -622,12 +612,63 @@ def process_chunks(chunks: Sequence[Chunk],
                     refine_results[z], polisher.zscores[z, :nr],
                     global_zs[z], status_counts, n_passes,
                     p.prep_ms + polish_ms)
-            bt.tally(failure)
-            if result is not None:
-                bt.results.append(result)
-        tally.merge(bt)
-        return tally
+            outcomes.append((failure, result))
+        return outcomes
     except Exception:  # noqa: BLE001 -- isolate faults via the serial path
-        tally.merge(process_chunks([p.chunk for p in preps], settings,
-                                   batch_polish=False))
+        fallback: list[tuple[Failure, ConsensusResult | None]] = []
+        for p in preps:
+            try:
+                fallback.append(process_chunk(p.chunk, settings))
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                fallback.append((Failure.OTHER, None))
+        return fallback
+
+
+def process_chunks(chunks: Sequence[Chunk],
+                   settings: ConsensusSettings | None = None,
+                   batch_polish: bool = True) -> ResultTally:
+    """Process a batch of ZMWs; exceptions become Other tallies and the batch
+    continues (reference Consensus.h:543-548).
+
+    With batch_polish (the default), all ZMWs that survive the host stages
+    polish together in one lockstep BatchPolisher (polish_prepared_batch) --
+    the TPU execution model (one batched device program per refinement
+    round) instead of the reference's one-thread-per-ZMW loop."""
+    settings = settings or ConsensusSettings()
+    tally = ResultTally()
+    # the lockstep BatchPolisher is the Arrow device path; Quiver polishes
+    # through the per-ZMW pipeline (its scorer batches fills internally)
+    if not batch_polish or settings.model == "quiver":
+        for chunk in chunks:
+            try:
+                failure, result = process_chunk(chunk, settings)
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                tally.tally(Failure.OTHER)
+                continue
+            tally.tally(failure)
+            if result is not None:
+                tally.results.append(result)
         return tally
+
+    from pbccs_tpu.runtime import timing
+
+    preps: list[PreparedZmw] = []
+    with timing.stage("draft"):
+        for chunk in chunks:
+            try:
+                failure, prep = prepare_chunk(chunk, settings)
+            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+                tally.tally(Failure.OTHER)
+                continue
+            if failure is not None:
+                tally.tally(failure)
+            else:
+                preps.append(prep)
+    if not preps:
+        return tally
+
+    for failure, result in polish_prepared_batch(preps, settings):
+        tally.tally(failure)
+        if result is not None:
+            tally.results.append(result)
+    return tally

@@ -67,15 +67,17 @@ def device_fetch(arr, dtype=None) -> np.ndarray:
 
 def reset() -> None:
     global _device_wait_s, _fetches
-    _device_wait_s = 0.0
-    _fetches = 0
     with _lock:
+        _device_wait_s = 0.0
+        _fetches = 0
         _stage_s.clear()
 
 
 def device_wait_seconds() -> float:
-    return _device_wait_s
+    with _lock:
+        return _device_wait_s
 
 
 def fetch_count() -> int:
-    return _fetches
+    with _lock:
+        return _fetches

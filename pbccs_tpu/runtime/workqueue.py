@@ -10,6 +10,12 @@ On TPU the heavy lifting is batched device programs, so the pool's job is
 overlap of host stages (BAM decode, bucketing, writeback) with device
 compute -- threads, not processes, are the right tool (the GIL is released
 inside device calls and zlib).
+
+The pipeline bound counts results not yet CONSUMED, not tasks not yet
+finished: releasing the slot at task completion let `_futures` hold
+unboundedly many completed results whenever the consumer lagged the pool
+(the reference's bounded head set has the same consume-time semantics,
+WorkQueue.h:129-166).
 """
 
 from __future__ import annotations
@@ -34,28 +40,40 @@ class WorkQueue:
         self._futures: queue.Queue[Future | None] = queue.Queue()
         self._failed = threading.Event()
         self._first_error: BaseException | None = None
+        self._error_lock = threading.Lock()
+
+    def _raise_failed(self) -> None:
+        with self._error_lock:
+            err = self._first_error
+        raise RuntimeError("work queue failed; no new tasks accepted"
+                           ) from err
 
     def produce(self, fn: Callable[..., T], *args, **kwargs) -> None:
         """Submit a task; blocks when the pipeline is full (backpressure).
 
+        The slot is held until the result is CONSUMED from results(), so
+        max_pending bounds the completed-but-unconsumed backlog too.
         Raises the original worker exception if a prior task already failed
         (reference WorkQueue.h:108-111 exception propagation to the
-        producer)."""
+        producer); a producer blocked on a full pipeline wakes up and
+        raises when a worker fails while it waits."""
         if self._failed.is_set():
-            raise RuntimeError("work queue failed; no new tasks accepted"
-                               ) from self._first_error
-        self._sem.acquire()
+            self._raise_failed()
+        while not self._sem.acquire(timeout=0.05):
+            if self._failed.is_set():
+                self._raise_failed()
 
         def run():
             try:
                 return fn(*args, **kwargs)
             except BaseException as e:
-                if not self._failed.is_set():
-                    self._first_error = e
+                # publish the error BEFORE the flag: a producer/consumer
+                # woken by _failed must never observe _first_error unset
+                with self._error_lock:
+                    if self._first_error is None:
+                        self._first_error = e
                 self._failed.set()
                 raise
-            finally:
-                self._sem.release()
 
         self._futures.put(self._pool.submit(run))
 
@@ -65,12 +83,17 @@ class WorkQueue:
 
     def results(self) -> Iterator:
         """Yield task results in submission order; re-raises the first
-        worker exception (reference WorkQueue.h:129-166)."""
+        worker exception (reference WorkQueue.h:129-166).  Each task's
+        pipeline slot is released here, when its result is consumed."""
         while True:
             fut = self._futures.get()
             if fut is None:
                 break
-            yield fut.result()
+            try:
+                result = fut.result()
+            finally:
+                self._sem.release()
+            yield result
 
     def consume_with(self, consumer: Callable[[T], None]) -> None:
         for result in self.results():
@@ -78,6 +101,21 @@ class WorkQueue:
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=True)
+        # drain unconsumed results (consumer bailed early, e.g. on a worker
+        # exception) so any producer still blocked in acquire() can wake
+        while True:
+            try:
+                fut = self._futures.get_nowait()
+            except queue.Empty:
+                break
+            if fut is not None:
+                try:
+                    self._sem.release()
+                except ValueError:
+                    pass  # bounded: already fully released
+        # wake any consumer still blocked on the queue (producer aborted
+        # before finalize); a stray sentinel in a discarded queue is harmless
+        self._futures.put(None)
 
     def __enter__(self) -> "WorkQueue":
         return self

@@ -1,0 +1,28 @@
+"""Online CCS serving: long-lived engine, dynamic batching, NDJSON/TCP.
+
+The production-scale counterpart of the batch CLI (see docs/DESIGN.md
+"Serving"): `engine.CcsEngine` owns the device and batches concurrent
+requests; `server.CcsServer`/`client.CcsClient` speak the streaming
+protocol (`protocol`); `batcher.DynamicBatcher` is the socket-free
+scheduling core.  `ccs serve` (cli.py) is the process entry point.
+"""
+
+from pbccs_tpu.serve.batcher import Batch, DynamicBatcher, PendingItem
+from pbccs_tpu.serve.engine import (
+    CcsEngine,
+    EngineClosed,
+    EngineOverloaded,
+    Request,
+    ServeConfig,
+)
+
+__all__ = [
+    "Batch",
+    "CcsEngine",
+    "DynamicBatcher",
+    "EngineClosed",
+    "EngineOverloaded",
+    "PendingItem",
+    "Request",
+    "ServeConfig",
+]

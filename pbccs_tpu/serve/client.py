@@ -1,0 +1,179 @@
+"""Python client for the CCS serving protocol.
+
+One TCP session, many concurrent in-flight requests: `submit*` returns a
+PendingReply immediately, a background reader thread re-associates the
+out-of-order streamed replies by request id, and `.reply()` blocks the
+caller until that request's result lands.  Thread-safe: any number of
+caller threads may share one client (the load generator runs many).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Sequence
+
+import numpy as np
+
+from pbccs_tpu.pipeline import Chunk
+from pbccs_tpu.serve import protocol
+
+
+class ServeError(RuntimeError):
+    """A structured error reply from the server."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class PendingReply:
+    """Handle for one in-flight request."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._msg: dict[str, Any] | None = None
+
+    def _complete(self, msg: dict[str, Any]) -> None:
+        self._msg = msg
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def reply(self, timeout: float | None = None,
+              check: bool = True) -> dict[str, Any]:
+        """The raw reply message; with check (default), error replies
+        raise ServeError and a dropped connection raises ConnectionError."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"no reply for request {self.request_id!r}")
+        msg = self._msg
+        if check and msg.get("type") == protocol.TYPE_ERROR:
+            raise ServeError(msg.get("code", "unknown"),
+                             msg.get("error", ""))
+        if check and msg.get("type") == "__disconnected__":
+            raise ConnectionError("server connection closed mid-stream")
+        return msg
+
+
+class CcsClient:
+    """NDJSON/TCP client for `ccs serve` (context-manager friendly)."""
+
+    def __init__(self, host: str, port: int, timeout: float | None = None):
+        self._sock = socket.create_connection((host, port), timeout=30.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict[str, PendingReply] = {}
+        self._seq = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="ccs-client-reader")
+        self._reader.start()
+
+    # ----------------------------------------------------------- plumbing
+
+    def _next_id(self) -> str:
+        with self._plock:
+            self._seq += 1
+            return f"r{self._seq}"
+
+    def _send(self, msg: dict[str, Any], handle: PendingReply) -> None:
+        with self._plock:
+            self._pending[handle.request_id] = handle
+        try:
+            with self._wlock:
+                self._sock.sendall(protocol.encode_msg(msg))
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(handle.request_id, None)
+            raise ConnectionError(f"send failed: {e}") from None
+
+    def _read_loop(self) -> None:
+        try:
+            with self._sock.makefile("rb") as rf:
+                for line in rf:
+                    if not line.strip():
+                        continue
+                    try:
+                        msg = protocol.decode_line(line)
+                    except protocol.ProtocolError:
+                        continue  # never kill the reader on one bad frame
+                    rid = msg.get("id")
+                    with self._plock:
+                        handle = self._pending.pop(rid, None)
+                    if handle is not None:
+                        handle._complete(msg)
+        except OSError:
+            pass
+        finally:
+            # fail whatever is still waiting so callers unblock
+            with self._plock:
+                leftovers = list(self._pending.values())
+                self._pending.clear()
+            for handle in leftovers:
+                handle._complete({"type": "__disconnected__",
+                                  "id": handle.request_id})
+
+    # ------------------------------------------------------------- verbs
+
+    def submit_wire(self, zmw: dict[str, Any],
+                    deadline_ms: float | None = None) -> PendingReply:
+        """Submit an already-wire-shaped ZMW dict."""
+        handle = PendingReply(self._next_id())
+        msg: dict[str, Any] = {"verb": protocol.VERB_SUBMIT,
+                               "id": handle.request_id, "zmw": zmw}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = deadline_ms
+        self._send(msg, handle)
+        return handle
+
+    def submit_chunk(self, chunk: Chunk,
+                     deadline_ms: float | None = None) -> PendingReply:
+        return self.submit_wire(protocol.chunk_to_wire(chunk), deadline_ms)
+
+    def submit(self, zmw_id: str, reads: Sequence[str],
+               snr: Sequence[float] | None = None,
+               deadline_ms: float | None = None) -> PendingReply:
+        """Convenience: sequences as strings, default full-pass flags."""
+        snr = [8.0] * 4 if snr is None else [float(s) for s in np.asarray(snr)]
+        zmw = {"id": zmw_id, "snr": snr,
+               "reads": [{"seq": s} for s in reads]}
+        return self.submit_wire(zmw, deadline_ms)
+
+    def status(self, timeout: float | None = 30.0) -> dict[str, Any]:
+        handle = PendingReply(self._next_id())
+        self._send({"verb": protocol.VERB_STATUS, "id": handle.request_id},
+                   handle)
+        return handle.reply(timeout)
+
+    def ping(self, timeout: float | None = 30.0) -> None:
+        handle = PendingReply(self._next_id())
+        self._send({"verb": protocol.VERB_PING, "id": handle.request_id},
+                   handle)
+        handle.reply(timeout)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+    def __enter__(self) -> "CcsClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

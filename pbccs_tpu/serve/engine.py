@@ -1,0 +1,432 @@
+"""CcsEngine: the long-lived online CCS serving core.
+
+Owns device state and compiled polish programs for the lifetime of the
+process and turns independently-arriving ZMW requests into the batched
+lockstep polish programs the device wants (parallel.batch.BatchPolisher
+via pipeline.polish_prepared_batch).  The offline CLI knows its whole
+workload up front; the engine does not, so it:
+
+  * admits requests through a BOUNDED pool (max_pending): a full engine
+    rejects with EngineOverloaded instead of growing without bound --
+    the server maps this to a structured `overloaded` reply and the
+    client retries (backpressure reaches the edge instead of the OOM
+    killer);
+  * preps admitted requests (filter -> POA draft -> mapping, the host
+    stages) on a small worker pool, then parks them in the dynamic
+    batcher under their (Jmax, Imax) length bucket
+    (parallel.batch.length_bucket);
+  * flushes a bucket to the polish executor when it fills (max_batch)
+    or when its oldest request's deadline slack expires
+    (min(admit + max_wait, deadline - polish_margin); see
+    serve.batcher), so a lone request never waits longer than its slack
+    for company;
+  * completes each request individually (out-of-order across batches)
+    through its callback/event -- a raising request or batch fails THAT
+    batch's requests with a structured error and the engine keeps
+    serving.
+
+The device itself is single-owner: polish batches run on a dedicated
+executor (default 1 worker -- one lockstep batch on device at a time,
+matching the offline driver; the WorkQueue overlap trick applies to host
+stages, which here live on the prep workers)."""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Sequence
+
+from pbccs_tpu.pipeline import (
+    Chunk,
+    ConsensusResult,
+    ConsensusSettings,
+    Failure,
+    PreparedZmw,
+    polish_prepared_batch,
+    prepare_chunk,
+)
+from pbccs_tpu.runtime import timing
+from pbccs_tpu.runtime.logging import Logger
+from pbccs_tpu.serve.batcher import Batch, DynamicBatcher, PendingItem
+
+
+def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings):
+    """polish_prepared_batch with shapes pinned to the flush's length
+    bucket + pow2 Z/R: online flushes vary in size (1..max_batch ZMWs,
+    arbitrary read counts), and letting each draw pick its own shapes
+    would mint a fresh compiled device loop per (Z, R) combination -- the
+    same bounded-program-menu rule the offline straggler/wide-retry
+    sub-batches follow (parallel/batch.py BatchPolisher `buckets`)."""
+    from pbccs_tpu.parallel.batch import length_bucket
+    from pbccs_tpu.utils import next_pow2
+
+    jmax, imax = length_bucket(
+        max(len(p.css) for p in preps),
+        max((len(m.seq) for p in preps for m in p.mapped), default=8))
+    r = next_pow2(max(len(p.mapped) for p in preps), 4)
+    return polish_prepared_batch(preps, settings,
+                                 buckets=(imax, jmax, r),
+                                 min_z=next_pow2(len(preps), 4))
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission pool full: shed load, client should retry with backoff."""
+
+
+class EngineClosed(RuntimeError):
+    """Engine is shutting down (or never started); no new requests."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (see module docstring for the policy they drive)."""
+
+    max_batch: int = 16            # bucket fill-flush size (ZMWs per batch)
+    max_wait_ms: float = 250.0     # max time a request waits to be batched
+    max_pending: int = 256         # admitted-but-incomplete request bound
+    prep_workers: int = 2          # host draft/mapping threads
+    polish_workers: int = 1        # concurrent device batches
+    default_deadline_ms: float = 60_000.0   # per-request deadline default
+    polish_margin_ms: float = 0.0  # slack reserved for the polish itself
+    # the offline CLI's read-score input gate (cli.py --minReadScore),
+    # applied at admission so serve and offline see the same read sets
+    min_read_score: float = 0.75
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight ZMW request; completed exactly once."""
+
+    seq: int
+    chunk: Chunk
+    submit_t: float                  # monotonic admission time
+    deadline_t: float                # monotonic absolute deadline
+    callback: Callable[["Request"], None] | None = None
+    # outcome (exactly one of failure or error set at completion)
+    failure: Failure | None = None
+    result: ConsensusResult | None = None
+    error: str | None = None
+    latency_ms: float = 0.0
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class CcsEngine:
+    """Long-lived dynamic-batching consensus engine (see module doc)."""
+
+    def __init__(self, settings: ConsensusSettings | None = None,
+                 config: ServeConfig | None = None, *,
+                 prep_fn: Callable[..., tuple[Failure | None,
+                                              PreparedZmw | None]] | None = None,
+                 polish_fn: Callable[..., list[tuple[Failure,
+                                                     ConsensusResult | None]]]
+                 | None = None,
+                 logger: Logger | None = None):
+        """prep_fn/polish_fn default to the real pipeline stages; tests
+        inject stubs to exercise scheduling without device work."""
+        self.settings = settings or ConsensusSettings()
+        self.config = config or ServeConfig()
+        self._prep_fn = prep_fn or prepare_chunk
+        self._polish_fn = polish_fn or _polish_shape_pinned
+        self._log = logger or Logger.default()
+
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pending = 0            # admitted, not yet completed
+        self._admitted = 0
+        self._rejected = 0
+        self._completed = 0
+        self._errors = 0
+        self._in_flight_batches = 0
+        self._in_flight_zmws = 0
+        self._prep_queue: queue.Queue[Request | None] = queue.Queue()
+        self._batcher = DynamicBatcher(self.config.max_batch)
+        self._wake = threading.Condition()
+        self._closed = True
+        self._abort = False
+        self._stop_flush = False
+        self._start_t = 0.0
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "CcsEngine":
+        with self._lock:
+            if not self._closed:
+                return self
+            self._closed = False
+            self._abort = False
+            self._stop_flush = False
+        self._start_t = time.monotonic()
+        self._threads = [
+            threading.Thread(target=self._prep_worker, daemon=True,
+                             name=f"ccs-serve-prep-{i}")
+            for i in range(self.config.prep_workers)
+        ] + [
+            threading.Thread(target=self._flush_loop, daemon=True,
+                             name="ccs-serve-batcher"),
+        ] + [
+            threading.Thread(target=self._polish_worker, daemon=True,
+                             name=f"ccs-serve-polish-{i}")
+            for i in range(self.config.polish_workers)
+        ]
+        self._polish_queue: queue.Queue[Batch | None] = queue.Queue()
+        for t in self._threads:
+            t.start()
+        self._log.info(
+            f"ccs engine up: max_batch={self.config.max_batch} "
+            f"max_wait={self.config.max_wait_ms}ms "
+            f"max_pending={self.config.max_pending}")
+        return self
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission; with drain (default) finish everything already
+        admitted, else fail pending requests with a `closed` error."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._abort = not drain
+        if drain:
+            # wait for admitted requests to complete (they flow through
+            # prep -> batcher -> polish on their own; the flush loop ships
+            # not-yet-due buckets immediately once it sees _closed)
+            while True:
+                with self._lock:
+                    if self._pending == 0:
+                        break
+                with self._wake:
+                    self._wake.notify_all()
+                time.sleep(0.01)
+        # stop the workers (flush loop last: it must outlive the preps so
+        # a request prepped during the drain still gets shipped)
+        for _ in range(self.config.prep_workers):
+            self._prep_queue.put(None)
+        with self._wake:
+            self._wake.notify_all()
+        for t in self._threads:
+            if t.name.startswith("ccs-serve-prep"):
+                t.join(timeout=10.0)
+        with self._lock:
+            self._stop_flush = True
+        with self._wake:
+            self._wake.notify_all()
+        for _ in range(self.config.polish_workers):
+            self._polish_queue.put(None)
+        for t in self._threads:
+            t.join(timeout=10.0)
+        if not drain:
+            # fail whatever is still parked anywhere
+            leftovers = [i.payload[0] for b in self._batcher.drain()
+                         for i in b.items]
+            while True:
+                try:
+                    req = self._prep_queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not None:
+                    leftovers.append(req)
+            for req in leftovers:
+                self._complete_error(req, "engine closed")
+        self._log.info("ccs engine down")
+
+    def __enter__(self) -> "CcsEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- admission
+
+    def submit(self, chunk: Chunk, deadline_ms: float | None = None,
+               callback: Callable[[Request], None] | None = None) -> Request:
+        """Admit one ZMW; returns its Request handle (completes via
+        callback and/or .wait()).  Raises EngineOverloaded when max_pending
+        requests are in the system and EngineClosed after close()."""
+        now = time.monotonic()
+        deadline_ms = (self.config.default_deadline_ms
+                       if deadline_ms is None else float(deadline_ms))
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("engine is not accepting requests")
+            if self._pending >= self.config.max_pending:
+                self._rejected += 1
+                raise EngineOverloaded(
+                    f"{self._pending} requests pending (max "
+                    f"{self.config.max_pending})")
+            self._pending += 1
+            self._admitted += 1
+            self._seq += 1
+            req = Request(seq=self._seq, chunk=chunk, submit_t=now,
+                          deadline_t=now + deadline_ms / 1e3,
+                          callback=callback)
+        self._prep_queue.put(req)
+        return req
+
+    # ---------------------------------------------------------------- stages
+
+    def _prep_worker(self) -> None:
+        while True:
+            req = self._prep_queue.get()
+            if req is None:
+                return
+            with self._lock:
+                aborting = self._abort
+            if aborting:
+                self._complete_error(req, "engine closed")
+                continue
+            # the offline CLI's read-score input gate (cli.py), applied
+            # pre-draft so serve and offline polish the same read sets
+            kept = [r for r in req.chunk.reads
+                    if r.read_accuracy >= self.config.min_read_score]
+            if len(kept) != len(req.chunk.reads):
+                req.chunk = Chunk(req.chunk.id, kept, req.chunk.snr)
+            try:
+                with timing.stage("serve.prep"):
+                    failure, prep = self._prep_fn(req.chunk, self.settings)
+            except Exception as e:  # noqa: BLE001 -- isolate the request
+                self._complete_error(req, f"prep failed: {e!r}")
+                continue
+            if failure is not None:
+                self._complete(req, failure, None)
+                continue
+            from pbccs_tpu.parallel.batch import length_bucket
+
+            key = length_bucket(
+                len(prep.css),
+                max((len(m.seq) for m in prep.mapped), default=8))
+            slack_end = req.deadline_t - self.config.polish_margin_ms / 1e3
+            flush_by = min(req.submit_t + self.config.max_wait_ms / 1e3,
+                           slack_end)
+            filled = self._batcher.add(PendingItem(
+                key=key, payload=(req, prep), admit_t=req.submit_t,
+                flush_by=flush_by))
+            if filled is not None:
+                self._dispatch(filled)
+            else:
+                with self._wake:
+                    self._wake.notify_all()  # re-arm the flush timer
+
+    def _flush_loop(self) -> None:
+        """Sleep until the earliest flush-by, then ship due buckets.
+
+        Exits only on _stop_flush (set after the prep workers join), so a
+        request prepped during a close() drain is still shipped."""
+        while True:
+            with self._lock:
+                if self._stop_flush:
+                    return
+                closed = self._closed
+            with self._wake:
+                nxt = self._batcher.next_deadline()
+                if nxt is None:
+                    # closed-but-empty still naps: close() may be waiting
+                    # on in-flight polishes and this must not busy-spin
+                    self._wake.wait(timeout=0.05 if closed else 0.2)
+                else:
+                    delay = nxt - time.monotonic()
+                    if delay > 0 and not closed:
+                        self._wake.wait(timeout=min(delay, 0.2))
+            with self._lock:
+                closed = self._closed
+            batches = self._batcher.due(time.monotonic())
+            if closed:
+                # shutting down: ship everything, due or not
+                batches += self._batcher.drain()
+            for batch in batches:
+                self._dispatch(batch)
+
+    def _dispatch(self, batch: Batch) -> None:
+        with self._lock:
+            self._in_flight_batches += 1
+            self._in_flight_zmws += len(batch.items)
+        self._log.debug(
+            f"flush bucket={batch.key} n={len(batch.items)} "
+            f"reason={batch.reason}")
+        self._polish_queue.put(batch)
+
+    def _polish_worker(self) -> None:
+        while True:
+            batch = self._polish_queue.get()
+            if batch is None:
+                return
+            reqs = [item.payload[0] for item in batch.items]
+            preps = [item.payload[1] for item in batch.items]
+            try:
+                with timing.stage("serve.polish"):
+                    outcomes = self._polish_fn(preps, self.settings)
+                if len(outcomes) != len(reqs):
+                    raise RuntimeError(
+                        f"polish returned {len(outcomes)} outcomes for "
+                        f"{len(reqs)} requests")
+            except Exception as e:  # noqa: BLE001 -- fail THIS batch only
+                for req in reqs:
+                    self._complete_error(req, f"polish failed: {e!r}")
+            else:
+                for req, (failure, result) in zip(reqs, outcomes):
+                    self._complete(req, failure, result)
+            finally:
+                with self._lock:
+                    self._in_flight_batches -= 1
+                    self._in_flight_zmws -= len(batch.items)
+
+    # ------------------------------------------------------------ completion
+
+    def _finish(self, req: Request) -> None:
+        req.latency_ms = (time.monotonic() - req.submit_t) * 1e3
+        with self._lock:
+            self._pending -= 1
+            self._completed += 1
+            if req.error is not None:
+                self._errors += 1
+        req.done.set()
+        if req.callback is not None:
+            try:
+                req.callback(req)
+            except Exception as e:  # noqa: BLE001 -- a dead client must
+                # never take the engine down with it
+                self._log.debug(f"result callback failed: {e!r}")
+
+    def _complete(self, req: Request, failure: Failure,
+                  result: ConsensusResult | None) -> None:
+        req.failure, req.result = failure, result
+        self._finish(req)
+
+    def _complete_error(self, req: Request, message: str) -> None:
+        req.error = message
+        self._log.warn(f"request {req.chunk.id}: {message}")
+        self._finish(req)
+
+    # ---------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        """Engine introspection for the protocol's `status` verb."""
+        with self._lock:
+            snap = dict(
+                pending=self._pending,
+                admitted=self._admitted,
+                rejected=self._rejected,
+                completed=self._completed,
+                errors=self._errors,
+                in_flight_batches=self._in_flight_batches,
+                in_flight_zmws=self._in_flight_zmws,
+            )
+        stage_s = {k: round(v, 4) for k, v in timing.stage_seconds().items()}
+        return {
+            "engine": "ccs-serve",
+            "uptime_s": round(time.monotonic() - self._start_t, 3),
+            "queue_depth": max(0, snap["pending"] - snap["in_flight_zmws"]),
+            "bucketed": self._batcher.pending_count(),
+            "depth_by_bucket": self._batcher.depth_by_bucket(),
+            "max_pending": self.config.max_pending,
+            "max_batch": self.config.max_batch,
+            "max_wait_ms": self.config.max_wait_ms,
+            "stage_seconds": stage_s,
+            "device_wait_s": round(timing.device_wait_seconds(), 4),
+            "device_fetches": timing.fetch_count(),
+            **snap,
+        }

@@ -1,0 +1,172 @@
+"""Wire protocol of the online CCS serving engine: newline-delimited JSON.
+
+One JSON object per line, UTF-8, over a byte stream (TCP).  Client
+messages carry a `verb`; server messages carry a `type`.  Every client
+message may carry an `id` (any JSON string) which the server echoes on
+the reply, so concurrent requests on one session stream back
+out-of-order and the client re-associates them.  This module is
+transport-free -- encode/decode plus the ZMW/result wire layout -- so
+protocol tests never open a socket (server.py and client.py own the
+sockets).
+
+Client verbs:
+  submit  {"verb": "submit", "id": ..., "zmw": <zmw>, "deadline_ms": ...}
+  status  {"verb": "status", "id": ...}
+  ping    {"verb": "ping", "id": ...}
+
+Server replies:
+  result  {"type": "result", "id": ..., "status": "<Failure name>",
+           "zmw": ..., "latency_ms": ...,  # + on Success:
+           "sequence": ..., "qual": <phred+33>, "num_passes": ...,
+           "predicted_accuracy": ..., "avg_zscore": ...}
+  error   {"type": "error", "id": ..., "code": "<machine code>",
+           "error": "<human message>"}
+  status  {"type": "status", "id": ..., ...engine.status()...}
+  pong    {"type": "pong", "id": ...}
+
+Error codes: bad_request (unparseable/invalid message -- the session
+stays open), overloaded (admission queue full: backpressure, retry
+later), closed (engine shutting down), internal (the request raised
+inside the engine; the SERVER stays up, only this request fails).
+
+The ZMW wire layout mirrors pipeline.Chunk:
+  {"id": "movie/hole", "snr": [A, C, G, T],
+   "reads": [{"id": ..., "seq": "ACGT...", "flags": 3,
+              "accuracy": 0.8}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
+from pbccs_tpu.pipeline import Chunk, ConsensusResult, Failure, Subread
+
+PROTOCOL_VERSION = 1
+
+# client verbs
+VERB_SUBMIT = "submit"
+VERB_STATUS = "status"
+VERB_PING = "ping"
+
+# server reply types
+TYPE_RESULT = "result"
+TYPE_ERROR = "error"
+TYPE_STATUS = "status"
+TYPE_PONG = "pong"
+
+# error codes
+ERR_BAD_REQUEST = "bad_request"
+ERR_OVERLOADED = "overloaded"
+ERR_CLOSED = "closed"
+ERR_INTERNAL = "internal"
+
+
+class ProtocolError(ValueError):
+    """A message violates the wire contract (bad JSON, wrong field types,
+    missing required fields)."""
+
+
+def encode_msg(msg: dict[str, Any]) -> bytes:
+    """One NDJSON frame: compact JSON + newline."""
+    return json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+
+
+def decode_line(line: bytes | str) -> dict[str, Any]:
+    """Parse one NDJSON frame; raises ProtocolError on anything that is
+    not a JSON object."""
+    if isinstance(line, bytes):
+        try:
+            line = line.decode()
+        except UnicodeDecodeError as e:
+            raise ProtocolError(f"frame is not UTF-8: {e}") from None
+    try:
+        msg = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"frame is not JSON: {e}") from None
+    if not isinstance(msg, dict):
+        raise ProtocolError("frame is not a JSON object")
+    return msg
+
+
+# ------------------------------------------------------------------ ZMW wire
+
+def chunk_to_wire(chunk: Chunk) -> dict[str, Any]:
+    return {
+        "id": chunk.id,
+        "snr": [float(s) for s in np.asarray(chunk.snr)],
+        "reads": [{"id": r.id, "seq": decode_bases(r.seq),
+                   "flags": int(r.flags),
+                   "accuracy": float(r.read_accuracy)}
+                  for r in chunk.reads],
+    }
+
+
+def chunk_from_wire(zmw: Any) -> Chunk:
+    """Validate + decode a submit message's `zmw` field; raises
+    ProtocolError with a client-actionable message on malformed input."""
+    if not isinstance(zmw, dict):
+        raise ProtocolError("zmw must be an object")
+    zid = zmw.get("id")
+    if not isinstance(zid, str) or not zid:
+        raise ProtocolError("zmw.id must be a non-empty string")
+    snr = zmw.get("snr", [8.0] * 4)
+    if (not isinstance(snr, list) or len(snr) != 4
+            or not all(isinstance(s, (int, float)) for s in snr)):
+        raise ProtocolError("zmw.snr must be 4 numbers (ACGT)")
+    reads = zmw.get("reads")
+    if not isinstance(reads, list) or not reads:
+        raise ProtocolError("zmw.reads must be a non-empty array")
+    subreads = []
+    for i, r in enumerate(reads):
+        if not isinstance(r, dict) or not isinstance(r.get("seq"), str):
+            raise ProtocolError(f"zmw.reads[{i}].seq must be a string")
+        try:
+            seq = encode_bases(r["seq"])
+        except UnicodeEncodeError:
+            raise ProtocolError(
+                f"zmw.reads[{i}].seq must be ASCII base characters"
+            ) from None
+        try:
+            flags = int(r.get("flags", 3))
+            accuracy = float(r.get("accuracy", 0.8))
+        except (TypeError, ValueError):
+            raise ProtocolError(
+                f"zmw.reads[{i}] flags/accuracy must be numeric") from None
+        subreads.append(Subread(id=str(r.get("id", f"{zid}/{i}")), seq=seq,
+                                flags=flags, read_accuracy=accuracy))
+    return Chunk(zid, subreads, np.asarray(snr, np.float64))
+
+
+# --------------------------------------------------------------- result wire
+
+def result_to_wire(request_id: Any, zmw_id: str, failure: Failure,
+                   result: ConsensusResult | None,
+                   latency_ms: float) -> dict[str, Any]:
+    """One streamed per-ZMW result (Success carries the consensus; any
+    other status is a structured yield-gate outcome, not an error)."""
+    msg: dict[str, Any] = {
+        "type": TYPE_RESULT,
+        "id": request_id,
+        "zmw": zmw_id,
+        "status": failure.value,
+        "latency_ms": round(float(latency_ms), 3),
+    }
+    if result is not None:
+        msg.update(
+            sequence=result.sequence,
+            qual=result.qualities,
+            num_passes=int(result.num_passes),
+            predicted_accuracy=round(float(result.predicted_accuracy), 6),
+            avg_zscore=(float(result.avg_zscore)
+                        if np.isfinite(result.avg_zscore) else None),
+        )
+    return msg
+
+
+def error_to_wire(request_id: Any, code: str, message: str) -> dict[str, Any]:
+    return {"type": TYPE_ERROR, "id": request_id, "code": code,
+            "error": message}

@@ -1,0 +1,290 @@
+"""TCP front end of the serving engine: NDJSON sessions over sockets.
+
+One accept loop, one reader thread per client session.  Replies are
+written by whichever thread completes them (engine polish workers via
+the request callback, the session reader for status/ping/errors) under a
+per-session write lock, so per-ZMW results STREAM back as they complete
+-- out of order across requests, interleaved across the session's
+in-flight submissions.
+
+Failure containment: a malformed frame gets a structured `bad_request`
+reply and the session lives on; an engine-side raise gets `internal` and
+the server lives on; a client that disconnects mid-stream only kills its
+own session (its in-flight requests complete engine-side and their
+replies are dropped on the closed socket).
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+
+from pbccs_tpu.runtime.logging import Logger, LogLevel
+from pbccs_tpu.serve import protocol
+from pbccs_tpu.serve.engine import (
+    CcsEngine,
+    EngineClosed,
+    EngineOverloaded,
+    Request,
+    ServeConfig,
+)
+
+
+class _Session:
+    """One connected client: a reader loop + a locked writer."""
+
+    def __init__(self, server: "CcsServer", conn: socket.socket, peer):
+        self.server = server
+        self.conn = conn
+        self.peer = peer
+        self.alive = True
+        self._wlock = threading.Lock()
+
+    def send(self, msg: dict) -> None:
+        """Best-effort reply: a dead socket marks the session closed but
+        never raises into the completer (engine callbacks must survive
+        client disconnects)."""
+        data = protocol.encode_msg(msg)
+        try:
+            with self._wlock:
+                self.conn.sendall(data)
+        except OSError:
+            self.alive = False
+
+    # ------------------------------------------------------------- verbs
+
+    def _on_submit(self, msg: dict) -> None:
+        rid = msg.get("id")
+        try:
+            chunk = protocol.chunk_from_wire(msg.get("zmw"))
+        except protocol.ProtocolError as e:
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_BAD_REQUEST, str(e)))
+            return
+        deadline_ms = msg.get("deadline_ms")
+        if deadline_ms is not None and not isinstance(deadline_ms,
+                                                      (int, float)):
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_BAD_REQUEST, "deadline_ms must be a number"))
+            return
+
+        def on_done(req: Request) -> None:
+            if req.error is not None:
+                self.send(protocol.error_to_wire(
+                    rid, protocol.ERR_INTERNAL, req.error))
+            else:
+                self.send(protocol.result_to_wire(
+                    rid, req.chunk.id, req.failure, req.result,
+                    req.latency_ms))
+
+        try:
+            self.server.engine.submit(chunk, deadline_ms=deadline_ms,
+                                      callback=on_done)
+        except EngineOverloaded as e:
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_OVERLOADED, str(e)))
+        except EngineClosed as e:
+            self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
+                                             str(e)))
+
+    def _on_status(self, msg: dict) -> None:
+        status = self.server.engine.status()
+        status.update(type=protocol.TYPE_STATUS, id=msg.get("id"),
+                      sessions=self.server.session_count(),
+                      protocol_version=protocol.PROTOCOL_VERSION)
+        self.send(status)
+
+    # ------------------------------------------------------------- reader
+
+    def run(self) -> None:
+        log = self.server.log
+        log.debug(f"session open: {self.peer}")
+        try:
+            with self.conn.makefile("rb") as rf:
+                for line in rf:
+                    if not line.strip():
+                        continue
+                    try:
+                        msg = protocol.decode_line(line)
+                    except protocol.ProtocolError as e:
+                        self.send(protocol.error_to_wire(
+                            None, protocol.ERR_BAD_REQUEST, str(e)))
+                        continue
+                    verb = msg.get("verb")
+                    if verb == protocol.VERB_SUBMIT:
+                        self._on_submit(msg)
+                    elif verb == protocol.VERB_STATUS:
+                        self._on_status(msg)
+                    elif verb == protocol.VERB_PING:
+                        self.send({"type": protocol.TYPE_PONG,
+                                   "id": msg.get("id")})
+                    else:
+                        self.send(protocol.error_to_wire(
+                            msg.get("id"), protocol.ERR_BAD_REQUEST,
+                            f"unknown verb: {verb!r}"))
+        except OSError:
+            pass  # peer reset mid-read: same as EOF
+        finally:
+            self.alive = False
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+            self.server._forget(self)
+            log.debug(f"session closed: {self.peer}")
+
+
+class CcsServer:
+    """Threaded NDJSON-over-TCP server fronting one CcsEngine."""
+
+    def __init__(self, engine: CcsEngine, host: str = "127.0.0.1",
+                 port: int = 0, logger: Logger | None = None):
+        self.engine = engine
+        self.log = logger or Logger.default()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        # closing a socket does not reliably wake a blocking accept() on
+        # Linux; a short accept timeout lets the loop observe shutdown
+        self._sock.settimeout(0.2)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._sessions: set[_Session] = set()
+        self._slock = threading.Lock()
+        self._accept_thread: threading.Thread | None = None
+        self._shutdown = threading.Event()
+
+    def session_count(self) -> int:
+        with self._slock:
+            return len(self._sessions)
+
+    def _forget(self, session: _Session) -> None:
+        with self._slock:
+            self._sessions.discard(session)
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listening socket closed
+            conn.settimeout(None)  # sessions block; accept timeout is ours
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # keepalive reaps sessions whose peer vanished without FIN
+            # (power loss, NAT timeout): without it the reader thread and
+            # fd of every half-open session leak for the server's lifetime
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            session = _Session(self, conn, peer)
+            with self._slock:
+                self._sessions.add(session)
+            threading.Thread(target=session.run, daemon=True,
+                             name=f"ccs-serve-session-{peer}").start()
+
+    def start(self) -> "CcsServer":
+        """Start accepting in the background; returns immediately."""
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="ccs-serve-accept")
+        self._accept_thread.start()
+        self.log.info(f"ccs serve listening on {self.host}:{self.port}")
+        return self
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            self._shutdown.wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._slock:
+            sessions = list(self._sessions)
+        for s in sessions:
+            try:
+                s.conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "CcsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+# ------------------------------------------------------------------- ccs serve
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    defaults = ServeConfig()  # one source of defaults (engine.ServeConfig)
+    p = argparse.ArgumentParser(
+        prog="ccs serve",
+        description="Serve CCS consensus over a streaming NDJSON/TCP "
+                    "protocol (long-lived engine, dynamic batching).")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="Bind address. Default = %(default)s")
+    p.add_argument("--port", type=int, default=7331,
+                   help="Bind port (0 = ephemeral). Default = %(default)s")
+    p.add_argument("--maxBatch", type=int, default=defaults.max_batch,
+                   help="ZMWs per polish batch (bucket fill-flush size). "
+                        "Default = %(default)s")
+    p.add_argument("--maxWaitMs", type=float, default=defaults.max_wait_ms,
+                   help="Max time a request waits to be batched before a "
+                        "deadline flush. Default = %(default)s")
+    p.add_argument("--maxPending", type=int, default=defaults.max_pending,
+                   help="Admission bound: requests in the system before "
+                        "submits are rejected as overloaded. "
+                        "Default = %(default)s")
+    p.add_argument("--prepWorkers", type=int, default=defaults.prep_workers,
+                   help="Host draft/mapping threads. Default = %(default)s")
+    p.add_argument("--deadlineMs", type=float,
+                   default=defaults.default_deadline_ms,
+                   help="Default per-request deadline. Default = %(default)s")
+    # consensus knobs shared (definition and defaults) with the offline CLI
+    from pbccs_tpu.cli import add_consensus_args
+
+    add_consensus_args(p)
+    p.add_argument("--logLevel", default="INFO")
+    return p
+
+
+def run_serve(argv: list[str] | None = None) -> int:
+    """`ccs serve` entry point (dispatched from pbccs_tpu.cli)."""
+    args = build_serve_parser().parse_args(argv)
+
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+
+    from pbccs_tpu.cli import consensus_settings_from_args
+
+    settings = consensus_settings_from_args(args)
+    config = ServeConfig(
+        max_batch=args.maxBatch,
+        max_wait_ms=args.maxWaitMs,
+        max_pending=args.maxPending,
+        prep_workers=args.prepWorkers,
+        default_deadline_ms=args.deadlineMs,
+        min_read_score=args.minReadScore)
+
+    with CcsEngine(settings, config, logger=log) as engine:
+        server = CcsServer(engine, args.host, args.port, logger=log)
+        # machine-readable ready line for wrappers (serve_bench polls it)
+        print(f"CCS-SERVE-READY {server.host} {server.port}", flush=True)
+        server.serve_forever()
+    log.flush()
+    return 0

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verification gate: a fast syntax sweep, then the exact ROADMAP.md
+# tier-1 test command.  CI (.github/workflows/tier1.yml) and humans run the
+# same script, so "tier-1 green" means one thing.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== compileall gate =="
+python -m compileall -q pbccs_tpu tools || exit 1
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
