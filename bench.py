@@ -166,11 +166,14 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     for rep in range(repeats):
         tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes,
                                     n_corruptions)
-        timing.reset()
+        # a per-repeat measurement window instead of the old global
+        # reset(): concurrent measurement (a live serve engine, another
+        # bench) can no longer clobber this repeat's counters
+        win = timing.window()
         t0 = time.monotonic()
         tpls, results, qvs = run_all(tasks)
         run_times.append(time.monotonic() - t0)
-        wait_times.append(timing.device_wait_seconds())
+        wait_times.append(timing.device_wait_seconds(win))
         if rep == 0:
             # accuracy is scored on the FIRST timed repeat's draw: the rng
             # stream position (seed 20260729, draw #2 after warmup) is the
@@ -284,11 +287,11 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
         assert rc == 0, f"cli.run failed rc={rc}"
         times, stage_runs = [], []
         for _ in range(repeats):
-            timing.reset()
+            win = timing.window()
             t0 = time.monotonic()
             rc = cli.run(argv)
             times.append(time.monotonic() - t0)
-            stage_runs.append(timing.stage_seconds())
+            stage_runs.append(timing.stage_seconds(win))
             assert rc == 0
     finally:
         import shutil
@@ -553,7 +556,7 @@ def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
         full_fa = os.path.join(tmp, "full.fasta")
         write_fasta(full_fa, tasks)
         from pbccs_tpu.runtime import timing
-        timing.reset()
+        win = timing.window()
         t0 = time.monotonic()
         rc = cli.run([os.path.join(tmp, "full.bam"), full_fa,
                       "--reportFile", os.path.join(tmp, "full.csv")]
@@ -561,7 +564,7 @@ def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
         dt = time.monotonic() - t0
         assert rc == 0
         stages = {k: round(v, 3) for k, v in sorted(
-            timing.stage_seconds().items(), key=lambda kv: -kv[1])}
+            timing.stage_seconds(win).items(), key=lambda kv: -kv[1])}
         rows = {}
         with open(os.path.join(tmp, "full.csv")) as f:
             for line in f:     # headerless "label,count,pct" rows

@@ -104,6 +104,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--logFile", default=None, help="Log to a file vs stderr.")
     p.add_argument("--logLevel", default="INFO",
                    help="TRACE..FATAL. Default = %(default)s")
+    p.add_argument("--trace-out", dest="trace_out", default=None,
+                   metavar="FILE",
+                   help="Write a Chrome-trace/Perfetto JSON of per-ZMW "
+                        "spans (filter/draft/polish/emit, wall vs "
+                        "device-wait) to FILE.")
+    p.add_argument("--profile-dir", dest="profile_dir", default=None,
+                   metavar="DIR",
+                   help="Capture a jax.profiler trace of the run into DIR "
+                        "(TensorBoard/XProf format).")
     p.add_argument("--reportFile", default="ccs_report.csv",
                    help="Where to write the yield report. Default = %(default)s")
     p.add_argument("--skipChemistryCheck", action="store_true",
@@ -238,6 +247,43 @@ def run(argv: list[str] | None = None) -> int:
             print(f"input file does not exist: {f}", file=sys.stderr)
             return 2
 
+    from pbccs_tpu.obs import profiling
+    from pbccs_tpu.obs import trace as obs_trace
+    from pbccs_tpu.runtime import timing
+
+    # end-of-run observability: a measurement window over this run (the
+    # summary table below reports its deltas) plus the opt-in capture
+    # surfaces (--trace-out spans, --profile-dir jax profiler)
+    run_window = timing.window()
+    tracer = None
+    if args.trace_out:
+        tracer = obs_trace.Tracer()
+        if not obs_trace.install_tracer(tracer):  # CAS: never hijack a
+            # capture another owner (e.g. an in-process serve engine)
+            # already has running
+            log.warn("--trace-out ignored: another span capture is "
+                     "already running in this process")
+            tracer = None
+    try:
+        with profiling.profile_capture(args.profile_dir):
+            _run_pipeline(args, files, whitelist, settings, log)
+    finally:
+        if tracer is not None:
+            obs_trace.clear_tracer(tracer)
+            tracer.write_json(args.trace_out)
+            log.info(f"trace spans written to {args.trace_out}")
+
+    from pbccs_tpu.obs.metrics import default_registry
+
+    summary = default_registry().summary_table(run_window)
+    log.info("run metrics:\n" + summary)
+    log.flush()
+    return 0
+
+
+def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
+    """The reader -> WorkQueue -> batched polish -> writer body of a CLI
+    run (split from run() so the observability capture scopes wrap it)."""
     # Default to at least 2 workers even on a 1-core host: a worker
     # blocks on the device with the GIL released for most of a batch
     # polish, so a second worker drafts the NEXT batch (host POA) during
@@ -271,6 +317,7 @@ def run(argv: list[str] | None = None) -> int:
 
     to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta", ".fsa"))
 
+    from pbccs_tpu.obs import trace as obs_trace
     from pbccs_tpu.runtime import timing
 
     # The work queue's max_pending bounds results not yet CONSUMED, so the
@@ -314,7 +361,8 @@ def run(argv: list[str] | None = None) -> int:
 
     if to_fasta:
         from pbccs_tpu.io.fasta import write_fasta
-        with timing.stage("write"):
+        with obs_trace.span("emit", results=len(tally.results)), \
+                timing.stage("write"):
             write_fasta(args.output,
                         ((f"{r.id}/ccs", r.sequence) for r in tally.results))
     else:
@@ -326,7 +374,8 @@ def run(argv: list[str] | None = None) -> int:
         # output BAM (reference src/main/ccs.cpp:120, 380)
         from pbccs_tpu.io.pbi import PbiBuilder, read_group_numeric_id
         uposs = []
-        with timing.stage("write"):
+        with obs_trace.span("emit", results=len(tally.results)), \
+                timing.stage("write"):
             with BamWriter(args.output, header) as bw:
                 for result in tally.results:
                     uposs.append(bw.write(writer_record(result)))
@@ -343,9 +392,7 @@ def run(argv: list[str] | None = None) -> int:
 
     with open(args.reportFile, "w") as rf:
         write_results_report(rf, tally)
-
-    log.flush()
-    return 0
+    return tally
 
 
 def main() -> None:

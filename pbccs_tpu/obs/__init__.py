@@ -1,0 +1,36 @@
+"""Unified observability layer: metrics, traces, and profiling hooks.
+
+Three cooperating pieces, all host-side and dependency-free (no jax
+import at module load, so the CLI's argument errors stay fast):
+
+  * obs.metrics -- a thread-safe MetricsRegistry (counters, gauges,
+    histograms with fixed log-scale buckets) with Prometheus text
+    exposition and per-registry MeasurementScope windows (concurrent
+    measurement windows instead of one global reset);
+  * obs.trace -- per-ZMW span trees (filter -> draft -> polish rounds ->
+    emit) with wall vs device-wait attribution, exported as
+    Chrome-trace/Perfetto JSON (`--trace-out`, serve `trace` verb);
+  * obs.profiling -- the opt-in jax.profiler capture hook
+    (`--profile-dir`).
+
+`runtime/timing.py` keeps its historical module-level API as a
+back-compat shim over the default registry, so existing callers
+(bench.py, engine status) see identical semantics.
+"""
+
+from pbccs_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MeasurementScope,
+    MetricsRegistry,
+    default_registry,
+    log_buckets,
+)
+from pbccs_tpu.obs.profiling import profile_capture  # noqa: F401
+from pbccs_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+)

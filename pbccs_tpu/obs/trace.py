@@ -1,0 +1,208 @@
+"""Per-ZMW trace spans with wall vs device-wait attribution.
+
+A Tracer collects a span tree per thread (filter -> draft -> polish
+rounds -> emit) and exports Chrome-trace/Perfetto JSON ("traceEvents"
+with complete "X" events: load chrome://tracing or ui.perfetto.dev).
+Wall time is the span's duration; device-wait seconds are attributed to
+the INNERMOST open span of the thread that blocked
+(runtime/timing.device_fetch routes its measured blocking time here), so
+a polish span decomposes into host marshalling vs device wait -- the
+meaningful split on this environment's tunneled device link
+(docs/DESIGN.md, "The transfer-count rule").
+
+Tracing is OFF unless a tracer is installed (CLI --trace-out, serve
+`trace` verb); the disabled fast path is one global read per span() call,
+cheap enough to leave the instrumentation in the hot pipeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Iterator
+
+
+class Span:
+    """One finished-or-open span; nesting is per-thread."""
+
+    __slots__ = ("name", "args", "tid", "t0", "t1", "device_wait_s",
+                 "parent", "index")
+
+    def __init__(self, name: str, args: dict[str, Any], tid: int,
+                 t0: float, parent: "Span | None", index: int):
+        self.name = name
+        self.args = args
+        self.tid = tid
+        self.t0 = t0
+        self.t1 = t0
+        self.device_wait_s = 0.0
+        self.parent = parent
+        self.index = index
+
+    @property
+    def duration_s(self) -> float:
+        return self.t1 - self.t0
+
+
+class Tracer:
+    """Collects spans; thread-safe; export once at the end of a capture.
+
+    `max_spans` bounds the capture: a serve-side capture left running by
+    a vanished client must not grow at traffic rate until the OOM killer
+    ends the engine.  Past the cap new spans are counted (dropped_spans,
+    surfaced in the export) but not recorded."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self.t_origin = time.perf_counter()
+        self.max_spans = max_spans
+        self.dropped_spans = 0
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- spans
+
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[Span | None]:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped_spans += 1
+                sp = None
+            else:
+                index = len(self._spans)
+                sp = Span(name, args, threading.get_ident() & 0xFFFFFFFF,
+                          time.perf_counter(), parent, index)
+                self._spans.append(sp)
+        if sp is None:
+            yield None
+            return
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.t1 = time.perf_counter()
+            stack.pop()
+
+    def add_device_wait(self, dt: float) -> None:
+        """Attribute dt blocking seconds to the calling thread's innermost
+        open span (no-op when the thread is not inside a span)."""
+        stack = self._stack()
+        if stack:
+            stack[-1].device_wait_s += dt
+
+    # ------------------------------------------------------------ reading
+
+    def finished_spans(self) -> list[Span]:
+        """Snapshot of spans recorded so far (open spans included, with
+        t1 frozen at their start)."""
+        with self._lock:
+            return list(self._spans)
+
+    def to_chrome(self) -> dict[str, Any]:
+        """Chrome-trace JSON object.  ts/dur are microseconds from the
+        tracer's origin; device-wait attribution and the parent span index
+        ride in args (the span TREE survives the round trip)."""
+        events = []
+        for sp in self.finished_spans():
+            args = dict(sp.args)
+            args["device_wait_ms"] = round(sp.device_wait_s * 1e3, 3)
+            if sp.parent is not None:
+                args["parent"] = sp.parent.index
+            events.append({
+                "name": sp.name,
+                "cat": "ccs",
+                "ph": "X",
+                "pid": 0,
+                "tid": sp.tid,
+                "ts": round((sp.t0 - self.t_origin) * 1e6, 1),
+                "dur": round((sp.t1 - sp.t0) * 1e6, 1),
+                "id": sp.index,
+                "args": args,
+            })
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self.dropped_spans:
+            out["droppedSpans"] = self.dropped_spans
+        return out
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+
+
+def span_tree(chrome: dict[str, Any]) -> dict[int | None, list[dict]]:
+    """Rebuild parent -> children from an exported Chrome-trace object
+    (the inverse of Tracer.to_chrome; trace smoke + round-trip tests)."""
+    tree: dict[int | None, list[dict]] = {}
+    for ev in chrome.get("traceEvents", []):
+        tree.setdefault(ev.get("args", {}).get("parent"), []).append(ev)
+    return tree
+
+
+# ------------------------------------------------------------- global hook
+
+_tracer: Tracer | None = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer | None:
+    return _tracer
+
+
+def set_tracer(tracer: Tracer | None) -> Tracer | None:
+    """Install (or clear) the process-wide tracer; returns the previous
+    one so nested captures can restore it."""
+    global _tracer
+    with _tracer_lock:
+        prev, _tracer = _tracer, tracer
+    return prev
+
+
+def install_tracer(tracer: Tracer) -> bool:
+    """Compare-and-swap install: succeeds only when no capture is live.
+    Concurrent owners (CLI --trace-out, serve trace verb) must use this,
+    not set_tracer, so one cannot silently hijack the other's capture."""
+    global _tracer
+    with _tracer_lock:
+        if _tracer is not None:
+            return False
+        _tracer = tracer
+        return True
+
+
+def clear_tracer(expected: Tracer) -> bool:
+    """Compare-and-swap clear: uninstalls only if `expected` is still the
+    live tracer (never tears down someone else's capture)."""
+    global _tracer
+    with _tracer_lock:
+        if _tracer is not expected:
+            return False
+        _tracer = None
+        return True
+
+
+@contextlib.contextmanager
+def span(name: str, **args) -> Iterator[Span | None]:
+    """Record a span on the installed tracer; no-op (one global read)
+    when tracing is off."""
+    t = _tracer
+    if t is None:
+        yield None
+        return
+    with t.span(name, **args) as sp:
+        yield sp
+
+
+def add_device_wait(dt: float) -> None:
+    t = _tracer
+    if t is not None:
+        t.add_device_wait(dt)

@@ -58,9 +58,30 @@ from pbccs_tpu.ops.mutation_score import (
     edge_read_scores_fast,
     make_patches_fast,
 )
+from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
 from pbccs_tpu.runtime.timing import device_fetch
 from pbccs_tpu.utils import next_pow2
+
+# bucket fill / padding-waste observability: pow2 padding of the (Z, R)
+# axes is real device work, so the fill ratios tell later perf PRs how
+# much of a batch's FLOPs polish actual reads vs padding
+_reg = default_registry()
+_m_polishes = _reg.counter("ccs_batch_polishes_total",
+                           "BatchPolisher batches constructed")
+_m_zmw_slots = _reg.counter("ccs_batch_slots_total",
+                            "Padded batch slots by axis", axis="zmw")
+_m_zmw_used = _reg.counter("ccs_batch_slots_used_total",
+                           "Occupied batch slots by axis", axis="zmw")
+_m_read_slots = _reg.counter("ccs_batch_slots_total", axis="read")
+_m_read_used = _reg.counter("ccs_batch_slots_used_total", axis="read")
+_FILL_BUCKETS = log_buckets(0.0625, 1.0, 2.0)
+_m_zmw_fill = _reg.histogram("ccs_batch_fill_ratio",
+                             "Used/padded slot ratio per batch by axis",
+                             buckets=_FILL_BUCKETS, axis="zmw")
+_m_read_fill = _reg.histogram("ccs_batch_fill_ratio",
+                              buckets=_FILL_BUCKETS, axis="read")
 
 # mutation-axis chunk: every scoring call uses this static M so one compiled
 # program serves every refinement round and the QV sweep
@@ -483,6 +504,15 @@ class BatchPolisher:
         self._real_rows = np.zeros((Z, R), bool)
         for z in range(self.n_zmws):
             self._real_rows[z, : int(self._n_reads[z])] = True
+
+        n_reads_real = int(self._n_reads[: self.n_zmws].sum())
+        _m_polishes.inc()
+        _m_zmw_slots.inc(Z)
+        _m_zmw_used.inc(self.n_zmws)
+        _m_read_slots.inc(Z * R)
+        _m_read_used.inc(n_reads_real)
+        _m_zmw_fill.observe(self.n_zmws / Z)
+        _m_read_fill.observe(n_reads_real / (Z * R))
 
         self._stats_host = None  # lazily fetched AddRead statistics
         self._cont = _Continuation()
@@ -1292,37 +1322,39 @@ class BatchPolisher:
                         self.tpls[z], favorable[z], opts.mutation_neighborhood))
             if all(done):
                 break
-            scores = self.score_mutation_arrays(arrs)
+            with obs_trace.span("polish.round", round=it,
+                                live=int((~done).sum())):
+                scores = self.score_mutation_arrays(arrs)
 
-            best_per_zmw: list[list[mutlib.Mutation]] = []
-            for z in range(Z):
-                if done[z]:
-                    best_per_zmw.append([])
-                    continue
-                results[z].iterations = it + 1
-                results[z].n_tested += arrs[z].size
-                favi = np.nonzero(scores[z] > eps_z[z])[0]
-                fav = arrs[z].take(favi).to_mutations(scores[z][favi])
-                favorable[z] = fav
-                if not fav:
-                    results[z].converged = True
-                    done[z] = True
-                    best_per_zmw.append([])
-                    continue
-                best = mutlib.best_subset(fav, opts.mutation_separation)
-                # cycle avoidance (Consensus-inl.hpp:229-241): trim a
-                # visited multi-mutation result to its best single
-                # mutation, but keep iterating (a repeated template does
-                # not terminate; see models/arrow/refine.py)
-                if len(best) > 1:
-                    nxt = mutlib.apply_mutations(self.tpls[z], best)
-                    if hash(nxt.tobytes()) in history[z]:
-                        best = [max(best, key=lambda m: m.score)]
-                history[z].add(hash(self.tpls[z].tobytes()))
-                results[z].n_applied += len(best)
-                best_per_zmw.append(best)
+                best_per_zmw: list[list[mutlib.Mutation]] = []
+                for z in range(Z):
+                    if done[z]:
+                        best_per_zmw.append([])
+                        continue
+                    results[z].iterations = it + 1
+                    results[z].n_tested += arrs[z].size
+                    favi = np.nonzero(scores[z] > eps_z[z])[0]
+                    fav = arrs[z].take(favi).to_mutations(scores[z][favi])
+                    favorable[z] = fav
+                    if not fav:
+                        results[z].converged = True
+                        done[z] = True
+                        best_per_zmw.append([])
+                        continue
+                    best = mutlib.best_subset(fav, opts.mutation_separation)
+                    # cycle avoidance (Consensus-inl.hpp:229-241): trim a
+                    # visited multi-mutation result to its best single
+                    # mutation, but keep iterating (a repeated template does
+                    # not terminate; see models/arrow/refine.py)
+                    if len(best) > 1:
+                        nxt = mutlib.apply_mutations(self.tpls[z], best)
+                        if hash(nxt.tobytes()) in history[z]:
+                            best = [max(best, key=lambda m: m.score)]
+                    history[z].add(hash(self.tpls[z].tobytes()))
+                    results[z].n_applied += len(best)
+                    best_per_zmw.append(best)
 
-            self.apply_mutations(best_per_zmw)
+                self.apply_mutations(best_per_zmw)
 
         return results
 

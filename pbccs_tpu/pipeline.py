@@ -19,6 +19,7 @@ from typing import Sequence
 
 import numpy as np
 
+from pbccs_tpu.obs import trace as obs_trace
 from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
 from pbccs_tpu.models.arrow.refine import (
     RefineOptions,
@@ -261,27 +262,31 @@ def prepare_chunk(chunk: Chunk, settings: ConsensusSettings
 
     from pbccs_tpu.runtime import timing
 
-    reads = filter_reads(chunk.reads, settings.min_length)
+    with obs_trace.span("filter", zmw=chunk.id):
+        reads = filter_reads(chunk.reads, settings.min_length)
     if not reads or all(r is None for r in reads):
         return Failure.NO_SUBREADS, None
 
-    with timing.stage("draft.poa"):
-        css, keys, summaries = poa_consensus(reads, settings.max_poa_coverage)
-    if len(css) < settings.min_length:
-        return Failure.TOO_SHORT, None
+    with obs_trace.span("draft", zmw=chunk.id):
+        with timing.stage("draft.poa"):
+            css, keys, summaries = poa_consensus(reads,
+                                                 settings.max_poa_coverage)
+        if len(css) < settings.min_length:
+            return Failure.TOO_SHORT, None
 
-    # map reads onto the draft
-    mapped: list[MappedRead] = []
-    n_unmappable = 0
-    with timing.stage("draft.map"):
-        for r, k in zip(reads, keys):
-            if r is None or k < 0:
-                continue
-            mr = extract_mapped_read(r, summaries[k], settings.min_length)
-            if mr is None:
-                n_unmappable += 1
-                continue
-            mapped.append(mr)
+        # map reads onto the draft
+        mapped: list[MappedRead] = []
+        n_unmappable = 0
+        with timing.stage("draft.map"):
+            for r, k in zip(reads, keys):
+                if r is None or k < 0:
+                    continue
+                mr = extract_mapped_read(r, summaries[k],
+                                         settings.min_length)
+                if mr is None:
+                    n_unmappable += 1
+                    continue
+                mapped.append(mr)
 
     n_candidates = sum(1 for k in keys if k >= 0)
     if not mapped:
@@ -475,8 +480,9 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
                          [m.strand for m in p.mapped],
                          [m.tpl_start for m in p.mapped],
                          [m.tpl_end for m in p.mapped]) for p in preps]
-        polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
-                                 buckets=buckets, min_z=min_z)
+        with obs_trace.span("polish.setup", zmws=len(preps)):
+            polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
+                                     buckets=buckets, min_z=min_z)
         gate_info = []
         for z, p in enumerate(preps):
             gate_info.append(_read_gates(p, polisher.statuses[z], settings))
@@ -548,7 +554,8 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
         # z-score statistics are reported for the draft template, before
         # refinement (parity with the serial path)
         global_zs = polisher.global_zscores()
-        refine_results = polisher.refine(settings.refine, skip=skip)
+        with obs_trace.span("polish.refine", zmws=len(preps) - len(skip)):
+            refine_results = polisher.refine(settings.refine, skip=skip)
         wide_refine = wide_qvs = wide_gz = None
         if wide_pick:
             try:  # the whole wide retry is speculative: any failure in its
@@ -586,7 +593,8 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
         # sweep (the most expensive single pass) for them
         skip = skip | {z for z, r in enumerate(refine_results)
                        if not r.converged}
-        qvs = polisher.consensus_qvs(skip=skip)
+        with obs_trace.span("polish.qv", zmws=len(preps) - len(skip)):
+            qvs = polisher.consensus_qvs(skip=skip)
         polish_s = time.monotonic() - t0
         timing.add_stage("polish", polish_s)
         polish_ms = polish_s * 1e3 / max(len(preps), 1)
@@ -667,7 +675,9 @@ def process_chunks(chunks: Sequence[Chunk],
     if not preps:
         return tally
 
-    for failure, result in polish_prepared_batch(preps, settings):
+    with obs_trace.span("polish", zmws=len(preps)):
+        outcomes = polish_prepared_batch(preps, settings)
+    for failure, result in outcomes:
         tally.tally(failure)
         if result is not None:
             tally.results.append(result)

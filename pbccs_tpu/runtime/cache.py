@@ -10,9 +10,51 @@ from __future__ import annotations
 
 import os
 
+_monitoring_installed = False
+
+
+def _install_cache_metrics() -> None:
+    """Route jax's compilation-cache monitoring events into the metrics
+    registry: ccs_compile_cache_events_total{kind="hit"|"miss"} plus
+    ccs_compiles_total for backend compiles.  Best-effort -- event names
+    are jax-internal and version-dependent, so unknown events are ignored
+    and a jax without jax.monitoring leaves the counters at zero."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    _monitoring_installed = True
+    from pbccs_tpu.obs.metrics import default_registry
+
+    reg = default_registry()
+    hits = reg.counter("ccs_compile_cache_events_total",
+                       "Persistent compilation cache hits/misses",
+                       kind="hit")
+    misses = reg.counter("ccs_compile_cache_events_total", kind="miss")
+    compiles = reg.counter("ccs_compiles_total",
+                           "Backend compile events observed via "
+                           "jax.monitoring")
+
+    def on_event(event: str, **kw) -> None:
+        if "compilation_cache" in event:
+            if "hit" in event:
+                hits.inc()
+            elif "miss" in event:
+                misses.inc()
+        elif "backend_compile" in event or event.endswith("/compile"):
+            compiles.inc()
+
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(on_event)
+    except Exception:  # noqa: BLE001 -- observability must not block setup
+        pass
+
 
 def enable_compilation_cache() -> str:
     import jax
+
+    _install_cache_metrics()
 
     configured = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
         jax.config.jax_compilation_cache_dir

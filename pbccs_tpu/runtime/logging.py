@@ -9,6 +9,7 @@ signal-handler flush).
 
 from __future__ import annotations
 
+import atexit
 import datetime
 import enum
 import queue
@@ -41,6 +42,8 @@ class Logger:
     """Async logger with a dedicated writer thread."""
 
     _default: "Logger | None" = None
+    _default_lock = threading.Lock()
+    _atexit_installed = False
 
     def __init__(self, stream: TextIO | None = None,
                  level: LogLevel = LogLevel.INFO):
@@ -98,11 +101,33 @@ class Logger:
 
     @classmethod
     def default(cls, logger: "Logger | None" = None) -> "Logger":
-        if logger is not None:
-            cls._default = logger
-        if cls._default is None:
-            cls._default = Logger()
-        return cls._default
+        """Get (or install) the process-default logger.
+
+        Locked: two threads racing the first call used to construct TWO
+        loggers -- two writer threads, interleaved half-installed state --
+        and the loser's writer thread leaked for the process lifetime."""
+        with cls._default_lock:
+            if logger is not None:
+                cls._default = logger
+            if cls._default is None:
+                cls._default = Logger()
+            if not cls._atexit_installed:
+                cls._atexit_installed = True
+                atexit.register(cls._flush_default_at_exit)
+            return cls._default
+
+    @classmethod
+    def _flush_default_at_exit(cls) -> None:
+        """Drain + stop the default logger's writer thread at interpreter
+        exit so queued records (e.g. from a CLI run) are never dropped."""
+        with cls._default_lock:
+            log = cls._default
+        if log is not None:
+            try:
+                log.flush()
+                log.close()
+            except Exception:  # noqa: BLE001 -- logging must never raise
+                pass
 
 
 def install_signal_handlers(logger: Logger | None = None) -> None:

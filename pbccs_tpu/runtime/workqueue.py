@@ -25,7 +25,18 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterator, TypeVar
 
+from pbccs_tpu.obs.metrics import default_registry
+
 T = TypeVar("T")
+
+_reg = default_registry()
+# shared across WorkQueue instances (concurrent queues sum; normally one)
+_depth = _reg.gauge("ccs_workqueue_depth",
+                    "Tasks produced but not yet consumed")
+_produced = _reg.counter("ccs_workqueue_produced_total",
+                         "Tasks submitted to the work queue")
+_consumed = _reg.counter("ccs_workqueue_consumed_total",
+                         "Task results consumed in order")
 
 
 class WorkQueue:
@@ -76,6 +87,8 @@ class WorkQueue:
                 raise
 
         self._futures.put(self._pool.submit(run))
+        _produced.inc()
+        _depth.inc()
 
     def finalize(self) -> None:
         """Signal that no more tasks will be produced."""
@@ -93,6 +106,8 @@ class WorkQueue:
                 result = fut.result()
             finally:
                 self._sem.release()
+                _depth.dec()
+                _consumed.inc()
             yield result
 
     def consume_with(self, consumer: Callable[[T], None]) -> None:
@@ -111,6 +126,7 @@ class WorkQueue:
             if fut is not None:
                 try:
                     self._sem.release()
+                    _depth.dec()
                 except ValueError:
                     pass  # bounded: already fully released
         # wake any consumer still blocked on the queue (producer aborted

@@ -23,7 +23,26 @@ import dataclasses
 import threading
 from typing import Any, Hashable
 
+from pbccs_tpu.obs.metrics import default_registry
+
 BucketKey = Hashable
+
+_reg = default_registry()
+_m_flushes = {reason: _reg.counter("ccs_serve_flushes_total",
+                                   "Bucket flushes by trigger",
+                                   reason=reason)
+              for reason in ("fill", "deadline", "drain")}
+_m_batch_zmws = _reg.histogram("ccs_serve_batch_zmws",
+                               "ZMWs per flushed batch",
+                               buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_m_bucketed = _reg.gauge("ccs_serve_bucketed",
+                         "Requests parked in the dynamic batcher")
+
+
+def _record_flush(batch: "Batch") -> "Batch":
+    _m_flushes[batch.reason].inc()
+    _m_batch_zmws.observe(len(batch.items))
+    return batch
 
 
 @dataclasses.dataclass
@@ -66,7 +85,9 @@ class DynamicBatcher:
             pending.append(item)
             if len(pending) >= self.max_batch:
                 del self._buckets[item.key]
-                return Batch(item.key, pending, "fill")
+                _m_bucketed.dec(len(pending) - 1)
+                return _record_flush(Batch(item.key, pending, "fill"))
+            _m_bucketed.inc()
             return None
 
     def due(self, now: float) -> list[Batch]:
@@ -80,14 +101,18 @@ class DynamicBatcher:
         with self._lock:
             for key in [k for k, items in self._buckets.items()
                         if min(i.flush_by for i in items) <= now]:
-                out.append(Batch(key, self._buckets.pop(key), "deadline"))
+                batch = Batch(key, self._buckets.pop(key), "deadline")
+                _m_bucketed.dec(len(batch.items))
+                out.append(_record_flush(batch))
         return out
 
     def drain(self) -> list[Batch]:
         """Pop everything (engine shutdown / flush-now)."""
         with self._lock:
-            out = [Batch(k, items, "drain")
+            out = [_record_flush(Batch(k, items, "drain"))
                    for k, items in self._buckets.items()]
+            for b in out:
+                _m_bucketed.dec(len(b.items))
             self._buckets.clear()
         return out
 

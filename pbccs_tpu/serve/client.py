@@ -150,6 +150,22 @@ class CcsClient:
                    handle)
         return handle.reply(timeout)
 
+    def metrics(self, timeout: float | None = 30.0) -> str:
+        """Prometheus text-format metrics scrape (the `metrics` verb)."""
+        handle = PendingReply(self._next_id())
+        self._send({"verb": protocol.VERB_METRICS,
+                    "id": handle.request_id}, handle)
+        return handle.reply(timeout).get("body", "")
+
+    def trace(self, action: str,
+              timeout: float | None = 30.0) -> dict[str, Any]:
+        """Start/stop a server-side span capture; a stop reply carries
+        the Chrome-trace JSON under "trace"."""
+        handle = PendingReply(self._next_id())
+        self._send({"verb": protocol.VERB_TRACE, "id": handle.request_id,
+                    "action": action}, handle)
+        return handle.reply(timeout)
+
     def ping(self, timeout: float | None = 30.0) -> None:
         handle = PendingReply(self._next_id())
         self._send({"verb": protocol.VERB_PING, "id": handle.request_id},

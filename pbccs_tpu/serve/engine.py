@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, Sequence
 
+from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.pipeline import (
     Chunk,
     ConsensusResult,
@@ -50,6 +52,26 @@ from pbccs_tpu.pipeline import (
 from pbccs_tpu.runtime import timing
 from pbccs_tpu.runtime.logging import Logger
 from pbccs_tpu.serve.batcher import Batch, DynamicBatcher, PendingItem
+
+_reg = default_registry()
+_m_admitted = _reg.counter("ccs_serve_admitted_total",
+                           "Requests admitted past the bounded pool")
+_m_rejected = _reg.counter("ccs_serve_rejected_total",
+                           "Submits rejected as overloaded")
+_m_completed = _reg.counter("ccs_serve_completed_total",
+                            "Requests completed (any outcome)")
+_m_errors = _reg.counter("ccs_serve_errors_total",
+                         "Requests completed with a structured error")
+_m_pending = _reg.gauge("ccs_serve_pending",
+                        "Admitted-but-incomplete requests")
+_m_inflight_batches = _reg.gauge("ccs_serve_in_flight_batches",
+                                 "Polish batches dispatched, not finished")
+_m_inflight_zmws = _reg.gauge("ccs_serve_in_flight_zmws",
+                              "ZMWs inside in-flight polish batches")
+# admission-to-completion latency; log buckets 1 ms .. ~5 min
+_m_latency = _reg.histogram("ccs_serve_request_latency_seconds",
+                            "Admission-to-completion request latency (s)",
+                            buckets=log_buckets(1e-3, 300.0))
 
 
 def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings):
@@ -135,6 +157,9 @@ class CcsEngine:
         self._log = logger or Logger.default()
 
         self._lock = threading.Lock()
+        self._window = timing.window()   # re-opened at start()
+        self._trace_lock = threading.Lock()
+        self._capture: obs_trace.Tracer | None = None
         self._seq = 0
         self._pending = 0            # admitted, not yet completed
         self._admitted = 0
@@ -162,6 +187,9 @@ class CcsEngine:
             self._abort = False
             self._stop_flush = False
         self._start_t = time.monotonic()
+        # the engine's OWN measurement window: a timing.reset() elsewhere
+        # in the process (bench.py) no longer clobbers engine counters
+        self._window = timing.window()
         self._threads = [
             threading.Thread(target=self._prep_worker, daemon=True,
                              name=f"ccs-serve-prep-{i}")
@@ -232,6 +260,7 @@ class CcsEngine:
                     leftovers.append(req)
             for req in leftovers:
                 self._complete_error(req, "engine closed")
+        self.trace_stop()  # never leak a live capture past the engine
         self._log.info("ccs engine down")
 
     def __enter__(self) -> "CcsEngine":
@@ -255,11 +284,14 @@ class CcsEngine:
                 raise EngineClosed("engine is not accepting requests")
             if self._pending >= self.config.max_pending:
                 self._rejected += 1
+                _m_rejected.inc()
                 raise EngineOverloaded(
                     f"{self._pending} requests pending (max "
                     f"{self.config.max_pending})")
             self._pending += 1
             self._admitted += 1
+            _m_admitted.inc()
+            _m_pending.inc()
             self._seq += 1
             req = Request(seq=self._seq, chunk=chunk, submit_t=now,
                           deadline_t=now + deadline_ms / 1e3,
@@ -286,7 +318,8 @@ class CcsEngine:
             if len(kept) != len(req.chunk.reads):
                 req.chunk = Chunk(req.chunk.id, kept, req.chunk.snr)
             try:
-                with timing.stage("serve.prep"):
+                with obs_trace.span("serve.prep", zmw=req.chunk.id), \
+                        timing.stage("serve.prep"):
                     failure, prep = self._prep_fn(req.chunk, self.settings)
             except Exception as e:  # noqa: BLE001 -- isolate the request
                 self._complete_error(req, f"prep failed: {e!r}")
@@ -344,6 +377,8 @@ class CcsEngine:
         with self._lock:
             self._in_flight_batches += 1
             self._in_flight_zmws += len(batch.items)
+        _m_inflight_batches.inc()
+        _m_inflight_zmws.inc(len(batch.items))
         self._log.debug(
             f"flush bucket={batch.key} n={len(batch.items)} "
             f"reason={batch.reason}")
@@ -357,7 +392,10 @@ class CcsEngine:
             reqs = [item.payload[0] for item in batch.items]
             preps = [item.payload[1] for item in batch.items]
             try:
-                with timing.stage("serve.polish"):
+                with obs_trace.span("serve.polish", bucket=str(batch.key),
+                                    zmws=len(batch.items),
+                                    reason=batch.reason), \
+                        timing.stage("serve.polish"):
                     outcomes = self._polish_fn(preps, self.settings)
                 if len(outcomes) != len(reqs):
                     raise RuntimeError(
@@ -373,6 +411,8 @@ class CcsEngine:
                 with self._lock:
                     self._in_flight_batches -= 1
                     self._in_flight_zmws -= len(batch.items)
+                _m_inflight_batches.dec()
+                _m_inflight_zmws.dec(len(batch.items))
 
     # ------------------------------------------------------------ completion
 
@@ -383,6 +423,11 @@ class CcsEngine:
             self._completed += 1
             if req.error is not None:
                 self._errors += 1
+        _m_pending.dec()
+        _m_completed.inc()
+        if req.error is not None:
+            _m_errors.inc()
+        _m_latency.observe(req.latency_ms / 1e3)
         req.done.set()
         if req.callback is not None:
             try:
@@ -401,10 +446,13 @@ class CcsEngine:
         self._log.warn(f"request {req.chunk.id}: {message}")
         self._finish(req)
 
-    # ---------------------------------------------------------------- status
+    # ---------------------------------------- status / metrics / trace
 
     def status(self) -> dict:
-        """Engine introspection for the protocol's `status` verb."""
+        """Engine introspection for the protocol's `status` verb.  Stage
+        and device-wait figures come from the engine's OWN measurement
+        window (opened at start()), so concurrent windows elsewhere in
+        the process cannot clobber them."""
         with self._lock:
             snap = dict(
                 pending=self._pending,
@@ -415,7 +463,8 @@ class CcsEngine:
                 in_flight_batches=self._in_flight_batches,
                 in_flight_zmws=self._in_flight_zmws,
             )
-        stage_s = {k: round(v, 4) for k, v in timing.stage_seconds().items()}
+        stage_s = {k: round(v, 4)
+                   for k, v in timing.stage_seconds(self._window).items()}
         return {
             "engine": "ccs-serve",
             "uptime_s": round(time.monotonic() - self._start_t, 3),
@@ -426,7 +475,53 @@ class CcsEngine:
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
             "stage_seconds": stage_s,
-            "device_wait_s": round(timing.device_wait_seconds(), 4),
-            "device_fetches": timing.fetch_count(),
+            "device_wait_s": round(
+                timing.device_wait_seconds(self._window), 4),
+            "device_fetches": timing.fetch_count(self._window),
+            "metrics": self.metrics_snapshot(),
             **snap,
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process registry (the
+        protocol's `metrics` verb scrapes this)."""
+        return _reg.render_prometheus()
+
+    def metrics_snapshot(self) -> dict:
+        """Compact /metrics-style name->value snapshot (counters and
+        gauges only; histograms ride the text exposition) for the
+        `status` verb."""
+        out = {}
+        for (name, labels), (kind, val) in sorted(_reg.snapshot().items()):
+            if kind == "histogram" or not name.startswith(
+                    ("ccs_serve_", "ccs_batch_", "ccs_device_")):
+                continue
+            suffix = "{%s}" % ",".join(
+                f"{k}={v}" for k, v in labels) if labels else ""
+            out[name + suffix] = round(val, 6)
+        return out
+
+    def trace_start(self) -> bool:
+        """Install a process-wide capture tracer (the protocol's `trace`
+        verb, action=start).  Returns False when a capture -- this
+        engine's or anyone else's -- is already running."""
+        with self._trace_lock:
+            if self._capture is not None:
+                return False
+            cap = obs_trace.Tracer()
+            if not obs_trace.install_tracer(cap):  # someone else's capture
+                return False
+            self._capture = cap
+            return True
+
+    def trace_stop(self) -> dict | None:
+        """Stop the capture and return the Chrome-trace JSON object
+        (None when no capture was running).  Clears the global tracer
+        only if it is still OUR capture (CAS) -- never tears down a
+        capture another owner installed since."""
+        with self._trace_lock:
+            cap, self._capture = self._capture, None
+            if cap is None:
+                return None
+            obs_trace.clear_tracer(cap)
+        return cap.to_chrome()

@@ -12,6 +12,8 @@ sockets).
 Client verbs:
   submit  {"verb": "submit", "id": ..., "zmw": <zmw>, "deadline_ms": ...}
   status  {"verb": "status", "id": ...}
+  metrics {"verb": "metrics", "id": ...}
+  trace   {"verb": "trace", "id": ..., "action": "start" | "stop"}
   ping    {"verb": "ping", "id": ...}
 
 Server replies:
@@ -22,6 +24,12 @@ Server replies:
   error   {"type": "error", "id": ..., "code": "<machine code>",
            "error": "<human message>"}
   status  {"type": "status", "id": ..., ...engine.status()...}
+  metrics {"type": "metrics", "id": ...,
+           "content_type": "text/plain; version=0.0.4",
+           "body": "<Prometheus text exposition>"}
+  trace   {"type": "trace", "id": ..., "state": "started" |
+           "already_running" | "stopped" | "not_running",
+           "trace": {..Chrome-trace JSON..}}  # on state "stopped" only
   pong    {"type": "pong", "id": ...}
 
 Error codes: bad_request (unparseable/invalid message -- the session
@@ -50,13 +58,20 @@ PROTOCOL_VERSION = 1
 # client verbs
 VERB_SUBMIT = "submit"
 VERB_STATUS = "status"
+VERB_METRICS = "metrics"
+VERB_TRACE = "trace"
 VERB_PING = "ping"
 
 # server reply types
 TYPE_RESULT = "result"
 TYPE_ERROR = "error"
 TYPE_STATUS = "status"
+TYPE_METRICS = "metrics"
+TYPE_TRACE = "trace"
 TYPE_PONG = "pong"
+
+# the Prometheus text exposition format version the metrics verb speaks
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 # error codes
 ERR_BAD_REQUEST = "bad_request"

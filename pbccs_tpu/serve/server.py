@@ -96,6 +96,32 @@ class _Session:
                       protocol_version=protocol.PROTOCOL_VERSION)
         self.send(status)
 
+    def _on_metrics(self, msg: dict) -> None:
+        self.send({"type": protocol.TYPE_METRICS, "id": msg.get("id"),
+                   "content_type": protocol.METRICS_CONTENT_TYPE,
+                   "body": self.server.engine.metrics_text()})
+
+    def _on_trace(self, msg: dict) -> None:
+        rid = msg.get("id")
+        action = msg.get("action")
+        if action == "start":
+            started = self.server.engine.trace_start()
+            self.send({"type": protocol.TYPE_TRACE, "id": rid,
+                       "state": "started" if started
+                       else "already_running"})
+        elif action == "stop":
+            chrome = self.server.engine.trace_stop()
+            reply = {"type": protocol.TYPE_TRACE, "id": rid,
+                     "state": "stopped" if chrome is not None
+                     else "not_running"}
+            if chrome is not None:
+                reply["trace"] = chrome
+            self.send(reply)
+        else:
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_BAD_REQUEST,
+                'trace.action must be "start" or "stop"'))
+
     # ------------------------------------------------------------- reader
 
     def run(self) -> None:
@@ -117,6 +143,10 @@ class _Session:
                         self._on_submit(msg)
                     elif verb == protocol.VERB_STATUS:
                         self._on_status(msg)
+                    elif verb == protocol.VERB_METRICS:
+                        self._on_metrics(msg)
+                    elif verb == protocol.VERB_TRACE:
+                        self._on_trace(msg)
                     elif verb == protocol.VERB_PING:
                         self.send({"type": protocol.TYPE_PONG,
                                    "id": msg.get("id")})

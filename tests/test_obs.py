@@ -1,0 +1,375 @@
+"""Observability layer: metrics registry, trace spans, export surfaces.
+
+Covers the tentpole contracts: histogram bucketing edge cases, concurrent
+counter increments, measurement-scope isolation (the timing.reset()
+replacement), span-tree nesting + Chrome-trace export round trip with
+device-wait attribution, Prometheus text rendering, and a serve-session
+test that scrapes the `metrics` verb and asserts stage counters advance.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.obs import metrics as obs_metrics
+from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import MetricsRegistry, log_buckets
+from pbccs_tpu.runtime import timing
+
+
+# ---------------------------------------------------------------- metrics
+
+
+class TestCounters:
+    def test_inc_and_negative_rejected(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_get_or_create_by_name_and_labels(self):
+        reg = MetricsRegistry()
+        a = reg.counter("t_total", stage="draft")
+        b = reg.counter("t_total", stage="draft")
+        c = reg.counter("t_total", stage="polish")
+        assert a is b and a is not c
+
+    def test_kind_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_concurrent_increments_exact(self):
+        """8 threads x 5000 increments must lose nothing."""
+        reg = MetricsRegistry()
+        c = reg.counter("t_total")
+        g = reg.gauge("t_gauge")
+        n, per = 8, 5000
+
+        def worker():
+            for _ in range(per):
+                c.inc()
+                g.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n * per
+        assert g.value == n * per
+
+
+class TestHistogram:
+    def test_log_buckets(self):
+        b = log_buckets(1.0, 100.0, 10.0)
+        assert b == (1.0, 10.0, 100.0)
+        with pytest.raises(ValueError):
+            log_buckets(0.0, 1.0)
+        with pytest.raises(ValueError):
+            log_buckets(1.0, 2.0, 1.0)
+
+    def test_bucketing_edges(self):
+        """A value exactly on a bound lands in that bound's bucket
+        (Prometheus le semantics); below-first and above-last land in the
+        first and +Inf buckets."""
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 1.0, 1.0000001, 10.0, 99.0, 100.0, 1e9):
+            h.observe(v)
+        counts, s, n = h.snapshot()
+        # bucket semantics: <=1, <=10, <=100, +Inf
+        assert counts == (2, 2, 2, 1)
+        assert n == 7
+        assert s == pytest.approx(0.5 + 1 + 1.0000001 + 10 + 99 + 100 + 1e9)
+
+    def test_prometheus_cumulative_rendering(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        text = reg.render_prometheus()
+        assert '# TYPE lat_seconds histogram' in text
+        assert 'lat_seconds_bucket{le="1"} 1' in text
+        assert 'lat_seconds_bucket{le="10"} 2' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+        assert 'lat_seconds_count 3' in text
+
+    def test_concurrent_observes_exact(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.5,))
+        threads = [threading.Thread(
+            target=lambda: [h.observe(1.0) for _ in range(2000)])
+            for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        counts, s, n = h.snapshot()
+        assert n == 12000 and counts == (0, 12000) and s == 12000.0
+
+
+class TestScopes:
+    def test_scope_reports_deltas_only(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total")
+        c.inc(10)
+        scope = reg.scope()
+        c.inc(5)
+        assert scope.counter_value("t_total") == 5.0
+
+    def test_concurrent_scopes_do_not_clobber(self):
+        """The satellite contract: two measurement windows over one
+        registry are independent -- no global reset."""
+        reg = MetricsRegistry()
+        c = reg.counter("t_total")
+        bench = reg.scope()
+        c.inc(3)
+        engine = reg.scope()     # opened later: sees only what follows
+        c.inc(4)
+        assert bench.counter_value("t_total") == 7.0
+        assert engine.counter_value("t_total") == 4.0
+        # opening yet another scope (the old reset()) changes neither
+        reg.scope()
+        assert bench.counter_value("t_total") == 7.0
+        assert engine.counter_value("t_total") == 4.0
+
+    def test_histogram_delta(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0,))
+        h.observe(0.5)
+        scope = reg.scope()
+        h.observe(0.5)
+        h.observe(2.0)
+        counts, s, n = scope.delta()[("h", ())]
+        assert counts == (1, 1) and n == 2 and s == 2.5
+
+    def test_timing_shim_windows(self):
+        """timing.reset() only moves the module window; an explicit
+        window is unaffected (bench vs live engine isolation)."""
+        win = timing.window()
+        timing.add_stage("test_obs_stage", 1.0)
+        timing.reset()          # module window restarts ...
+        assert timing.stage_seconds().get("test_obs_stage") is None
+        # ... but the explicit window still sees the pre-reset second
+        assert timing.stage_seconds(win)["test_obs_stage"] == \
+            pytest.approx(1.0)
+
+
+# ------------------------------------------------------------------ trace
+
+
+class TestTrace:
+    def test_span_tree_nesting_and_round_trip(self):
+        tracer = obs_trace.Tracer()
+        with tracer.span("polish", zmws=2):
+            with tracer.span("polish.round", round=0):
+                pass
+            with tracer.span("polish.round", round=1):
+                pass
+        chrome = json.loads(json.dumps(tracer.to_chrome()))  # wire trip
+        events = chrome["traceEvents"]
+        assert [e["name"] for e in events] == \
+            ["polish", "polish.round", "polish.round"]
+        tree = obs_trace.span_tree(chrome)
+        roots = tree[None]
+        assert len(roots) == 1 and roots[0]["name"] == "polish"
+        children = tree[roots[0]["id"]]
+        assert [c["args"]["round"] for c in children] == [0, 1]
+        # children are contained in the parent's [ts, ts+dur]
+        for c in children:
+            assert c["ts"] >= roots[0]["ts"]
+            assert c["ts"] + c["dur"] <= \
+                roots[0]["ts"] + roots[0]["dur"] + 1e-6
+
+    def test_device_wait_attribution(self):
+        """timing.device_fetch inside a span attributes its blocking time
+        to the innermost open span."""
+        tracer = obs_trace.Tracer()
+        prev = obs_trace.set_tracer(tracer)
+        try:
+            with obs_trace.span("polish"):
+                with obs_trace.span("polish.round", round=0):
+                    timing.device_fetch(np.arange(4))
+        finally:
+            obs_trace.set_tracer(prev)
+        spans = {s.name: s for s in tracer.finished_spans()}
+        assert spans["polish.round"].device_wait_s >= 0.0
+        ev = [e for e in tracer.to_chrome()["traceEvents"]
+              if e["name"] == "polish.round"][0]
+        assert "device_wait_ms" in ev["args"]
+
+    def test_disabled_tracer_is_noop(self):
+        prev = obs_trace.set_tracer(None)
+        try:
+            with obs_trace.span("x") as sp:
+                assert sp is None
+            obs_trace.add_device_wait(1.0)  # must not raise
+        finally:
+            obs_trace.set_tracer(prev)
+
+    def test_span_cap_bounds_capture(self):
+        """A capture left running must not grow unboundedly: past
+        max_spans new spans are dropped and counted."""
+        tracer = obs_trace.Tracer(max_spans=3)
+        for i in range(5):
+            with tracer.span("s", i=i) as sp:
+                assert (sp is not None) == (i < 3)
+        assert len(tracer.finished_spans()) == 3
+        chrome = tracer.to_chrome()
+        assert chrome["droppedSpans"] == 2
+
+    def test_install_and_clear_are_cas(self):
+        """install_tracer refuses to hijack a live capture; clear_tracer
+        only uninstalls its own."""
+        prev = obs_trace.set_tracer(None)
+        try:
+            a, b = obs_trace.Tracer(), obs_trace.Tracer()
+            assert obs_trace.install_tracer(a)
+            assert not obs_trace.install_tracer(b)   # a's capture survives
+            assert not obs_trace.clear_tracer(b)     # b can't clear a's
+            assert obs_trace.get_tracer() is a
+            assert obs_trace.clear_tracer(a)
+            assert obs_trace.get_tracer() is None
+        finally:
+            obs_trace.set_tracer(prev)
+
+    def test_spans_across_threads_keep_separate_stacks(self):
+        tracer = obs_trace.Tracer()
+
+        def worker(i):
+            with tracer.span("w", i=i):
+                pass
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = tracer.finished_spans()
+        assert len(spans) == 4
+        assert all(s.parent is None for s in spans)  # no cross-thread nest
+
+
+# ------------------------------------------------------- serve integration
+
+
+class TestServeMetrics:
+    def test_metrics_verb_scrape_advances(self):
+        """A serve session scrapes the `metrics` verb before and after a
+        submit: admission and stage counters must advance, and the body
+        must be valid Prometheus text."""
+        from pbccs_tpu.serve.client import CcsClient
+        from pbccs_tpu.serve.server import CcsServer
+        from tests.test_serve import stub_engine
+
+        def scrape(body: str) -> dict[str, float]:
+            out = {}
+            for line in body.splitlines():
+                if line and not line.startswith("#"):
+                    name, _, v = line.rpartition(" ")
+                    out[name] = float(v)
+            return out
+
+        eng = stub_engine(max_batch=2, max_wait_ms=50.0).start()
+        srv = CcsServer(eng, port=0).start()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                before = scrape(cli.metrics())
+                assert "ccs_serve_admitted_total" in before
+                for i in range(3):
+                    msg = cli.submit(f"m/{i}", ["ACGTACGT"] * 4) \
+                        .reply(timeout=10.0)
+                    assert msg["status"] == "Success"
+                after = scrape(cli.metrics())
+                assert after["ccs_serve_admitted_total"] >= \
+                    before["ccs_serve_admitted_total"] + 3
+                assert after["ccs_serve_completed_total"] >= \
+                    before["ccs_serve_completed_total"] + 3
+                stage_key = 'ccs_stage_seconds_total{stage="serve.prep"}'
+                assert after[stage_key] > before.get(stage_key, 0.0)
+                lat = 'ccs_serve_request_latency_seconds_count'
+                assert after[lat] >= before.get(lat, 0.0) + 3
+                # flush accounting: the three submits flushed at least one
+                # fill batch (max_batch=2) and one deadline batch
+                flushes = [k for k in after if
+                           k.startswith("ccs_serve_flushes_total")]
+                assert sum(after[k] for k in flushes) >= \
+                    sum(before.get(k, 0.0) for k in flushes) + 2
+                # status carries the /metrics-style snapshot
+                st = cli.status()
+                assert "ccs_serve_admitted_total" in st["metrics"]
+        finally:
+            srv.shutdown()
+            eng.close()
+
+    def test_trace_verb_capture_round_trip(self):
+        from pbccs_tpu.serve.client import CcsClient
+        from pbccs_tpu.serve.server import CcsServer
+        from tests.test_serve import stub_engine
+
+        eng = stub_engine(max_batch=1, max_wait_ms=50.0).start()
+        srv = CcsServer(eng, port=0).start()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                assert cli.trace("stop")["state"] == "not_running"
+                assert cli.trace("start")["state"] == "started"
+                assert cli.trace("start")["state"] == "already_running"
+                msg = cli.submit("m/1", ["ACGTACGT"] * 4).reply(timeout=10.0)
+                assert msg["status"] == "Success"
+                reply = cli.trace("stop")
+                assert reply["state"] == "stopped"
+                names = {e["name"]
+                         for e in reply["trace"]["traceEvents"]}
+                assert "serve.prep" in names and "serve.polish" in names
+        finally:
+            srv.shutdown()
+            eng.close()
+            assert obs_trace.get_tracer() is None  # capture never leaks
+
+    def test_trace_bad_action_is_structured_error(self):
+        from pbccs_tpu.serve.client import CcsClient, ServeError
+        from pbccs_tpu.serve.server import CcsServer
+        from tests.test_serve import stub_engine
+
+        eng = stub_engine().start()
+        srv = CcsServer(eng, port=0).start()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                with pytest.raises(ServeError) as ei:
+                    cli.trace("frobnicate")
+                assert ei.value.code == "bad_request"
+        finally:
+            srv.shutdown()
+            eng.close()
+
+
+# ------------------------------------------------------------- summary/CLI
+
+
+class TestSummaryAndRegistry:
+    def test_summary_table_from_scope(self):
+        reg = MetricsRegistry()
+        scope = reg.scope()
+        reg.counter("ccs_demo_total", stage="x").inc(2)
+        reg.histogram("ccs_demo_seconds", buckets=(1.0,)).observe(0.5)
+        table = reg.summary_table(scope)
+        assert "ccs_demo_total{stage=x}" in table
+        assert "n=1" in table
+
+    def test_default_registry_is_shared(self):
+        assert obs_metrics.default_registry() is \
+            obs_metrics.default_registry()
+
+    def test_prometheus_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", stage='we"ird\n').inc()
+        text = reg.render_prometheus()
+        assert 'stage="we\\"ird\\n"' in text

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Observability smoke gate: run a tiny simulated workload through the
+CLI with --trace-out and validate the emitted Chrome-trace JSON schema.
+
+Part of tier-1 (tools/tier1.sh + .github/workflows/tier1.yml): the trace
+export is an interface later perf PRs read, so its shape is pinned in CI
+-- traceEvents present, complete ("X") events with ts/dur/pid/tid, the
+span tree covering filter -> draft -> polish -> emit, device-wait
+attribution on every span, and parent links that resolve.
+
+Exit 0 on success; prints the failure and exits 1 otherwise.
+
+Usage: JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# tiny shapes + the host refinement loop: this is a schema gate, not a
+# perf run, so keep the compile menu as small as possible on CPU
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PBCCS_DEVICE_REFINE", "0")
+
+REQUIRED_SPANS = {"filter", "draft", "polish", "emit"}
+EVENT_FIELDS = {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+
+
+def make_workload(path: str, n_zmws: int = 3, tpl_len: int = 60,
+                  n_passes: int = 4) -> None:
+    import numpy as np
+
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(20260803)
+    with open(path, "w") as f:
+        for z in range(n_zmws):
+            _, reads, _, _ = simulate_zmw(rng, tpl_len, n_passes)
+            start = 0
+            for read in reads:
+                seq = decode_bases(read)
+                f.write(f">smoke/{z}/{start}_{start + len(seq)}\n{seq}\n")
+                start += len(seq) + 20
+
+
+def validate_trace(trace: dict) -> list[str]:
+    problems = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    ids = {ev.get("id") for ev in events}
+    for ev in events:
+        missing = EVENT_FIELDS - set(ev)
+        if missing:
+            problems.append(f"event {ev.get('name')!r} missing {missing}")
+            continue
+        if ev["ph"] != "X":
+            problems.append(f"event {ev['name']!r}: ph={ev['ph']!r} != 'X'")
+        if ev["dur"] < 0 or ev["ts"] < 0:
+            problems.append(f"event {ev['name']!r}: negative ts/dur")
+        if "device_wait_ms" not in ev["args"]:
+            problems.append(f"event {ev['name']!r}: no device_wait_ms")
+        parent = ev["args"].get("parent")
+        if parent is not None and parent not in ids:
+            problems.append(f"event {ev['name']!r}: dangling parent "
+                            f"{parent}")
+    names = {ev["name"] for ev in events}
+    missing_spans = REQUIRED_SPANS - names
+    if missing_spans:
+        problems.append(f"required spans absent: {sorted(missing_spans)} "
+                        f"(got {sorted(names)})")
+    # device-wait attribution must land somewhere inside polish
+    polish = [ev for ev in events if ev["name"].startswith("polish")]
+    if polish and not any(ev["args"]["device_wait_ms"] > 0 for ev in polish):
+        problems.append("no polish span carries device-wait attribution")
+    return problems
+
+
+def main() -> int:
+    from pbccs_tpu import cli
+
+    tmp = tempfile.mkdtemp(prefix="pbccs_obs_smoke_")
+    fasta = os.path.join(tmp, "subreads.fasta")
+    trace_path = os.path.join(tmp, "trace.json")
+    make_workload(fasta)
+    rc = cli.run([os.path.join(tmp, "out.fasta"), fasta,
+                  "--skipChemistryCheck", "--zmws", "all",
+                  "--reportFile", os.path.join(tmp, "report.csv"),
+                  "--trace-out", trace_path])
+    if rc != 0:
+        print(f"obs_smoke: cli.run failed rc={rc}", file=sys.stderr)
+        return 1
+    with open(trace_path) as f:
+        trace = json.load(f)
+    problems = validate_trace(trace)
+    if problems:
+        for p in problems:
+            print(f"obs_smoke: {p}", file=sys.stderr)
+        return 1
+    n = len(trace["traceEvents"])
+    print(f"obs_smoke: OK ({n} spans, schema valid, "
+          f"spans cover {sorted(REQUIRED_SPANS)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

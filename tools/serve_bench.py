@@ -174,6 +174,20 @@ def pctl(xs, q):
     return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
 
 
+def parse_prometheus(body: str) -> dict[str, float]:
+    """Prometheus text -> {metric_with_labels: value} (comments dropped)."""
+    out: dict[str, float] = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     Logger.default(Logger(level=LogLevel.WARN))
@@ -257,6 +271,10 @@ def main(argv=None) -> int:
             report["chaos"] = run_chaos(host, port)
         with CcsClient(host, port) as cli:
             report["engine_status"] = cli.status(timeout=30.0)
+            # end-of-run metrics snapshot (the Prometheus scrape the
+            # `metrics` verb serves), parsed into name -> value so the
+            # JSON report stays greppable
+            report["metrics"] = parse_prometheus(cli.metrics(timeout=30.0))
     finally:
         if server is not None:
             server.shutdown()
