@@ -72,6 +72,38 @@ def add_consensus_args(p: argparse.ArgumentParser) -> None:
                    help="Polish model family (default: arrow, the ccs "
                         "model; quiver is the QV-feature model -- reads "
                         "without QV tracks use flat default tracks).")
+    p.add_argument("--degradeQuarantined", action="store_true",
+                   help="Emit quarantined poison ZMWs (batch AND serial "
+                        "polish failed) as draft-only consensus with a "
+                        "`df` tag and capped QVs instead of dropping "
+                        "them as Other.")
+
+
+def add_resilience_args(p: argparse.ArgumentParser) -> None:
+    """Fault-handling knobs shared by `ccs` and `ccs serve`."""
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="Arm deterministic fault injection (chaos "
+                        "testing), e.g. 'polish.dispatch:error~m/3'. "
+                        "See pbccs_tpu/resilience/faults.py for the "
+                        "grammar; PBCCS_FAULTS is the env equivalent.")
+    p.add_argument("--faultSeed", type=int, default=0,
+                   help="Seed for probabilistic fault specs. "
+                        "Default = %(default)s")
+    p.add_argument("--polishTimeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="Watchdog deadline per device dispatch: a hung "
+                        "polish becomes a structured timeout and the "
+                        "affected ZMWs quarantine instead of stalling "
+                        "the run (default: PBCCS_WATCHDOG_S, else off).")
+
+
+def apply_resilience_args(args) -> None:
+    from pbccs_tpu.resilience import faults, watchdog
+
+    if args.faults is not None:
+        faults.configure(args.faults, seed=args.faultSeed)
+    if args.polishTimeout is not None:
+        watchdog.configure(args.polishTimeout)
 
 
 def consensus_settings_from_args(args) -> ConsensusSettings:
@@ -82,7 +114,8 @@ def consensus_settings_from_args(args) -> ConsensusSettings:
         min_predicted_accuracy=args.minPredictedAccuracy,
         min_zscore=args.minZScore,
         max_drop_fraction=args.maxDropFraction,
-        model=args.model)
+        model=args.model,
+        degrade_quarantined=args.degradeQuarantined)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -115,6 +148,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(TensorBoard/XProf format).")
     p.add_argument("--reportFile", default="ccs_report.csv",
                    help="Where to write the yield report. Default = %(default)s")
+    p.add_argument("--checkpoint", default=None, metavar="FILE",
+                   help="Journal completed chunks to FILE (NDJSON) so a "
+                        "killed run can restart with --resume. Default: "
+                        "off (--resume implies OUTPUT.ckpt).")
+    p.add_argument("--resume", action="store_true",
+                   help="Restore completed chunks from the checkpoint "
+                        "journal and compute only the rest; the final "
+                        "tally and output are identical to an "
+                        "uninterrupted run.")
+    p.add_argument("--batchFallback", choices=("bisect", "serial"),
+                   default="bisect",
+                   help="Recovery when a lockstep polish batch fails: "
+                        "bisect isolates the poison ZMW(s) in O(k log Z) "
+                        "re-dispatches; serial re-runs the whole batch "
+                        "per-ZMW (legacy). Default = %(default)s")
+    add_resilience_args(p)
     p.add_argument("--skipChemistryCheck", action="store_true",
                    help="Accept non-P6-C4 read groups (required for FASTA "
                         "input, which carries no chemistry metadata).")
@@ -223,6 +272,7 @@ def run(argv: list[str] | None = None) -> int:
 
         return run_serve(argv[1:])
     args = build_parser().parse_args(argv)
+    apply_resilience_args(args)
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 
@@ -313,6 +363,9 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
                 "zs": [float(z) if math.isfinite(z) else 0.0
                        for z in result.zscores],
                 "rs": [int(c) for c in result.status_counts],
+                # draft-only degradation marker (resilience.quarantine):
+                # the sequence is the unpolished POA draft, QVs capped
+                **({"df": 1} if result.draft_only else {}),
             })
 
     to_fasta = any(args.output.endswith(e) for e in (".fa", ".fasta", ".fsa"))
@@ -326,35 +379,91 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
     # then-consume loop would deadlock once the pipeline fills.
     import threading
 
+    # checkpoint journal: restore completed chunks (--resume) and record
+    # each chunk as its results are consumed, in submission order, so a
+    # killed run loses at most the in-flight chunks
+    journal = None
+    restored: dict[int, ResultTally] = {}
+    ckpt_path = args.checkpoint or (args.output + ".ckpt"
+                                    if args.resume else None)
+    if ckpt_path:
+        from pbccs_tpu.resilience.checkpoint import (
+            CheckpointJournal,
+            run_fingerprint,
+        )
+
+        # every knob that changes chunk COMPOSITION must fingerprint:
+        # minReadScore filters reads and skipChemistryCheck drops ZMWs
+        # before batching (the rest ride in via settings/files)
+        fp = run_fingerprint(
+            files, args.chunkSize, settings,
+            extra={"zmws": args.zmws,
+                   "min_read_score": args.minReadScore,
+                   "skip_chemistry_check": bool(args.skipChemistryCheck)})
+        journal = CheckpointJournal(ckpt_path, logger=log)
+        if args.resume:
+            restored = journal.load(fp)
+            # output order must match an uninterrupted run: restored
+            # chunks splice ahead of recomputed ones, so only a
+            # CONTIGUOUS prefix is usable (a dropped mid-journal record
+            # invalidates everything after it -- recomputed, not stale)
+            k = 0
+            while k in restored:
+                k += 1
+            if len(restored) > k:
+                log.warn(f"resume: journal has a gap at chunk {k}; "
+                         f"recomputing {len(restored) - k} chunk(s) "
+                         "after it to preserve output order")
+            restored = {i: t for i, t in restored.items() if i < k}
+        journal.start(fp, resume=args.resume and bool(restored))
+
+    def _run_batch(idx, batch):
+        return idx, process_chunks(batch, settings,
+                                   on_error=args.batchFallback)
+
     consumed = ResultTally()
     consumer_error: list[BaseException] = []
 
     with WorkQueue(n_threads) as wq:
         def _consume():
             try:
-                for sub_tally in wq.results():
+                for idx, sub_tally in wq.results():
                     consumed.merge(sub_tally)
+                    if journal is not None:
+                        journal.record_chunk(idx, sub_tally)
             except BaseException as e:  # noqa: BLE001 -- re-raised below
                 consumer_error.append(e)
 
         consumer = threading.Thread(target=_consume, name="pbccs-consumer")
         consumer.start()
         it = iter(_chunks_from_files(files, whitelist, args, log, tally))
+        idx = -1
         while True:
             with timing.stage("read"):
                 batch = next(it, None)
             if batch is None:
                 break
+            idx += 1
             for chunk in batch:
                 movie = chunk.id.split("/")[0]
                 movies.setdefault(movie, ReadGroupInfo(movie, "CCS"))
+            if idx in restored:
+                # journaled chunks restore in index order BEFORE any
+                # newly computed chunk merges (journal records form a
+                # prefix), so output order matches an uninterrupted run
+                tally.merge(restored[idx])
+                continue
             with timing.stage("queue"):
-                wq.produce(process_chunks, batch, settings)
+                wq.produce(_run_batch, idx, batch)
         wq.finalize()
         consumer.join()
     if consumer_error:
         raise consumer_error[0]
     tally.merge(consumed)
+    if journal is not None:
+        # a completed run needs no resume point; a later --resume against
+        # fresh inputs must not splice stale results
+        journal.remove()
 
     log.info(f"processed {tally.total} ZMWs: "
              f"{tally.counts[Failure.SUCCESS]} successes")

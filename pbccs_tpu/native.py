@@ -36,11 +36,30 @@ def _load() -> Optional[ctypes.CDLL]:
              or (os.path.exists(src)
                  and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)))
     if stale and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        # a failed rebuild degrades to the pure-Python path, but it must
+        # be DIAGNOSABLE: log what broke (compiler stderr, timeout, a
+        # missing make) instead of swallowing everything
+        from pbccs_tpu.runtime.logging import Logger
+
         try:
-            subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
-                           capture_output=True, timeout=120, check=False)
-        except Exception:
-            pass
+            proc = subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
+                                  capture_output=True, timeout=120,
+                                  check=False)
+            if proc.returncode != 0:
+                stderr = proc.stderr.decode(errors="replace").strip()
+                Logger.default().warn(
+                    f"native library rebuild failed (make exit "
+                    f"{proc.returncode}); using pure-Python fallbacks. "
+                    f"stderr:\n{stderr[-2000:]}")
+        except subprocess.TimeoutExpired as e:
+            stderr = (e.stderr or b"").decode(errors="replace").strip()
+            Logger.default().warn(
+                f"native library rebuild timed out after {e.timeout:g}s; "
+                f"using pure-Python fallbacks. stderr:\n{stderr[-2000:]}")
+        except OSError as e:
+            Logger.default().warn(
+                f"native library rebuild could not run make ({e}); "
+                "using pure-Python fallbacks")
     if not os.path.exists(_LIB_PATH):
         return None
     try:

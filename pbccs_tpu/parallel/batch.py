@@ -122,6 +122,36 @@ def length_bucket(tpl_len: int, max_read_len: int) -> tuple[int, int]:
     return _jmax_bucket(tpl_len), _imax_bucket(max_read_len + 8)
 
 
+def effective_shapes(n_zmws: int, max_reads: int, max_read_len: int,
+                     max_tpl_len: int, *,
+                     buckets: tuple[int, int, int] | None = None,
+                     min_z: int = 1, zq: int = 1, rq: int = 1
+                     ) -> tuple[int, int, int, int]:
+    """The (Imax, Jmax, R, Z) a BatchPolisher with these inputs compiles
+    at -- the ONE place the bucket arithmetic lives.  BatchPolisher's
+    constructor uses it, and the quarantine bisection path
+    (pipeline._pinned_batch_shapes) uses it to pin sub-dispatches to the
+    parent batch's shapes, so isolating a poison ZMW replays compiled
+    programs and (W being a function of Jmax) reproduces surviving ZMWs
+    byte-identically."""
+    Z = pad_to(max(n_zmws, min_z), zq)
+    R = pad_to(max_reads, max(4, rq))
+    Imax = _imax_bucket(max_read_len + 8)
+    Jmax = _jmax_bucket(max_tpl_len)
+    if buckets is not None:
+        Imax = max(Imax, buckets[0])
+        R = max(R, buckets[2])
+        # adopt the parent's Jmax bucket EXACTLY when templates fit:
+        # letting _jmax_bucket of a mid-refinement template overshoot
+        # the parent bucket would mint a fresh draw-dependent shape
+        # (a cold compile, the very thing buckets exist to prevent)
+        if max_tpl_len + 2 <= buckets[1]:
+            Jmax = buckets[1]
+        else:
+            Jmax = max(Jmax, buckets[1])
+    return Imax, Jmax, R, Z
+
+
 @dataclasses.dataclass
 class ZmwTask:
     """One ZMW's polish-stage inputs (draft template + mapped reads)."""
@@ -452,24 +482,12 @@ class BatchPolisher:
 
         zq = mesh.shape[ZMW_AXIS] if mesh else 1
         rq = mesh.shape[READ_AXIS] if mesh else 1
-        self._Z = pad_to(max(self.n_zmws, min_z), zq)
-        self._R = pad_to(max(len(t.reads) for t in tasks), max(4, rq))
-        raw_imax = max((len(r) for t in tasks for r in t.reads),
-                       default=8) + 8
-        self._Imax = _imax_bucket(raw_imax)
-        max_l = max(len(t.tpl) for t in tasks)
-        self._Jmax = _jmax_bucket(max_l)
-        if buckets is not None:
-            self._Imax = max(self._Imax, buckets[0])
-            self._R = max(self._R, buckets[2])
-            # adopt the parent's Jmax bucket EXACTLY when templates fit:
-            # letting _jmax_bucket of a mid-refinement template overshoot
-            # the parent bucket would mint a fresh draw-dependent shape
-            # (a cold compile, the very thing buckets exist to prevent)
-            if max_l + 2 <= buckets[1]:
-                self._Jmax = buckets[1]
-            else:
-                self._Jmax = max(self._Jmax, buckets[1])
+        self._Imax, self._Jmax, self._R, self._Z = effective_shapes(
+            self.n_zmws,
+            max(len(t.reads) for t in tasks),
+            max((len(r) for t in tasks for r in t.reads), default=8),
+            max(len(t.tpl) for t in tasks),
+            buckets=buckets, min_z=min_z, zq=zq, rq=rq)
         self._W = effective_band_width(self.config.banding, self._Jmax)
 
         Z, R = self._Z, self._R

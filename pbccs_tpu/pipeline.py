@@ -15,11 +15,14 @@ from __future__ import annotations
 import dataclasses
 import enum
 import time
+import traceback
 from typing import Sequence
 
 import numpy as np
 
 from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.runtime.logging import Logger
 from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
 from pbccs_tpu.models.arrow.refine import (
     RefineOptions,
@@ -34,6 +37,24 @@ from pbccs_tpu.poa.sparse import PoaAlignmentSummary, SparsePoa
 # a full pass iff it is flanked by adapter hits on both sides).
 ADAPTER_BEFORE = 1
 ADAPTER_AFTER = 2
+
+_reg = default_registry()
+
+
+def record_zmw_failure(stage: str, exc: BaseException,
+                       zmw: str | None = None) -> None:
+    """Account one swallowed per-ZMW/per-batch exception: the class +
+    traceback go to the debug log and ccs_zmw_failures_total{stage,exc}
+    increments -- a fault-isolation boundary must never also be an
+    information sink (the pre-resilience handlers discarded both)."""
+    _reg.counter("ccs_zmw_failures_total",
+                 "Exceptions absorbed by per-ZMW fault isolation",
+                 stage=stage, exc=type(exc).__name__).inc()
+    where = f"{stage}[{zmw}]" if zmw else stage
+    tb = "".join(traceback.format_exception(type(exc), exc,
+                                            exc.__traceback__))
+    Logger.default().debug(
+        f"{where}: absorbed {type(exc).__name__}: {exc}\n{tb}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +75,10 @@ class ConsensusSettings:
     # templated refine/QV implementation, Consensus.hpp:64-79).  Subreads
     # without QV tracks polish with flat default tracks.
     model: str = "arrow"
+    # quarantined poison ZMWs (batch AND serial polish failed) emit a
+    # draft-only consensus (capped QVs, `df` tag) instead of dropping as
+    # Failure.OTHER (resilience.quarantine; off = reference parity)
+    degrade_quarantined: bool = False
 
 
 @dataclasses.dataclass
@@ -114,6 +139,9 @@ class ConsensusResult:
     mutations_applied: int
     snr: np.ndarray
     elapsed_ms: float
+    # set by resilience.quarantine.degrade_to_draft: the sequence is the
+    # unpolished POA draft with capped QVs (emitted with a `df` BAM tag)
+    draft_only: bool = False
 
     @property
     def qualities(self) -> str:
@@ -438,10 +466,231 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
     return polish_prepared(prep, settings)
 
 
+def _polish_batch_arrow(preps: Sequence[PreparedZmw],
+                        settings: ConsensusSettings, *,
+                        buckets: tuple[int, int, int] | None = None,
+                        min_z: int = 1
+                        ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """One lockstep BatchPolisher dispatch over `preps`: the raw Arrow
+    device path, outcomes ALIGNED with `preps`.  Raises on any batch-path
+    failure -- fault handling (hang watchdog, transient-error retry,
+    poison-ZMW quarantine) lives in polish_prepared_batch."""
+    from pbccs_tpu.runtime import timing
+
+    t0 = time.monotonic()
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+
+    tasks = [ZmwTask(p.chunk.id, p.css, np.asarray(p.chunk.snr),
+                     [m.seq for m in p.mapped],
+                     [m.strand for m in p.mapped],
+                     [m.tpl_start for m in p.mapped],
+                     [m.tpl_end for m in p.mapped]) for p in preps]
+    with obs_trace.span("polish.setup", zmws=len(preps)):
+        polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
+                                 buckets=buckets, min_z=min_z)
+    gate_info = []
+    for z, p in enumerate(preps):
+        gate_info.append(_read_gates(p, polisher.statuses[z], settings))
+    # ZMWs that shed reads to the alpha/beta mating gate retry in ONE
+    # wider-band (2x) sub-batch -- the batched analogue of the serial
+    # scorer's whole-scorer escalation (the reference rebands a
+    # mismatched pair up to 5 times before dropping,
+    # SimpleRecursor.cpp:642-691).  Keep-better-width per ZMW: a ZMW
+    # polishes at the wide band iff it MATES more reads there
+    # (status != ALPHABETAMISMATCH -- deliberately counting reads the
+    # wide band mates but the z-score gate then drops: the reference
+    # rebands to achieve alpha/beta agreement FIRST and applies the
+    # z-score gate to whatever mated, so reband-to-mate-then-gate is
+    # the parity semantics, not mates-that-survive-gating).  Otherwise
+    # it stays in the narrow batch with its drops (the serial retry's
+    # revert).  Either way the ZMW stays on the batched device path.
+    reband = sorted(z for z, p in enumerate(preps)
+                    if (polisher.statuses[z, : len(p.mapped)]
+                        == ADD_ALPHABETAMISMATCH).any())
+    wide = None
+    wide_pick: dict[int, int] = {}
+    if reband:
+        wcfg = dataclasses.replace(
+            polisher.config,
+            banding=dataclasses.replace(
+                polisher.config.banding,
+                # 2x the EFFECTIVE width (the W(L) schedule may have
+                # shrunk the narrow batch below the configured width);
+                # a non-default width passes through the schedule
+                band_width=2 * polisher._W))
+        try:  # speculative build: any failure keeps the narrow batch
+            from pbccs_tpu.utils import next_pow2
+
+            # pin shapes to the narrow batch's buckets + pow2 Z so the
+            # data-dependent reband count doesn't mint fresh compiles
+            wide = BatchPolisher([tasks[z] for z in reband],
+                                 config=wcfg,
+                                 min_zscore=settings.min_zscore,
+                                 buckets=(polisher._Imax,
+                                          polisher._Jmax,
+                                          polisher._R),
+                                 min_z=next_pow2(len(reband), 4))
+        except Exception as e:  # noqa: BLE001 -- keep the narrow batch
+            record_zmw_failure("polish.wide_build", e,
+                               zmw=f"reband[{len(reband)}]")
+            wide = None
+        if wide is not None:
+            for i, z in enumerate(reband):
+                nr = len(preps[z].mapped)
+                n_narrow = int((polisher.statuses[z, :nr]
+                                != ADD_ALPHABETAMISMATCH).sum())
+                n_wide = int((wide.statuses[i, :nr]
+                              != ADD_ALPHABETAMISMATCH).sum())
+                if n_wide > n_narrow:
+                    wide_pick[z] = i
+                    gate_info[z] = _read_gates(
+                        preps[z], wide.statuses[i], settings)
+        # banding observability: retry outcomes per batch (the
+        # reference's NumFlipFlops analogue at batch granularity)
+        Logger.default().debug(
+            f"band retry: {len(reband)} ZMW(s) had mating failures at "
+            f"W={polisher._W}; "
+            f"{len(wide_pick)} adopted the 2x band, "
+            f"{len(reband) - len(wide_pick)} reverted")
+    # gate-failed ZMWs are excluded from refinement/QV (the serial path
+    # returns before polishing them); their batch slots stay idle
+    gate_failed = {z for z, g in enumerate(gate_info) if g[0] is not None}
+    skip = gate_failed | set(wide_pick)
+    # z-score statistics are reported for the draft template, before
+    # refinement (parity with the serial path)
+    global_zs = polisher.global_zscores()
+    with obs_trace.span("polish.refine", zmws=len(preps) - len(skip)):
+        refine_results = polisher.refine(settings.refine, skip=skip)
+    wide_refine = wide_qvs = wide_gz = None
+    if wide_pick:
+        try:  # the whole wide retry is speculative: any failure in its
+            # polish falls back to the narrow batch's completed results
+            # (with the narrow gates) instead of discarding the batch
+            wide_skip = {i for i in range(wide.n_zmws)
+                         if i not in {wi for z, wi in wide_pick.items()
+                                      if z not in gate_failed}}
+            wide_gz = wide.global_zscores()
+            wide_refine = wide.refine(settings.refine, skip=wide_skip)
+            wide_qvs = wide.consensus_qvs(
+                skip=wide_skip | {i for i, r in enumerate(wide_refine)
+                                  if not r.converged})
+        except Exception as e:  # noqa: BLE001 -- revert to narrow batch
+            record_zmw_failure("polish.wide", e,
+                               zmw=f"reband[{len(wide_pick)}]")
+            retry = set(wide_pick)
+            for z in list(wide_pick):
+                gate_info[z] = _read_gates(
+                    preps[z], polisher.statuses[z], settings)
+            wide_pick.clear()
+            gate_failed = {z for z, g in enumerate(gate_info)
+                           if g[0] is not None}
+            skip = gate_failed
+            # refine ONLY the formerly wide-routed ZMWs: the rest of
+            # the narrow batch already refined in the first pass, and
+            # re-running them would hand non-convergent ZMWs a second
+            # full iteration budget and rebuild their refine stats
+            todo = retry - gate_failed
+            if todo:
+                retry_results = polisher.refine(
+                    settings.refine,
+                    skip=set(range(polisher.n_zmws)) - todo)
+                for z in todo:
+                    refine_results[z] = retry_results[z]
+    # non-converged ZMWs are discarded by _finish_zmw; don't pay the QV
+    # sweep (the most expensive single pass) for them
+    skip = skip | {z for z, r in enumerate(refine_results)
+                   if not r.converged}
+    with obs_trace.span("polish.qv", zmws=len(preps) - len(skip)):
+        qvs = polisher.consensus_qvs(skip=skip)
+    polish_s = time.monotonic() - t0
+    timing.add_stage("polish", polish_s)
+    polish_ms = polish_s * 1e3 / max(len(preps), 1)
+
+    # outcomes accumulate into a local list so a mid-loop fault cannot
+    # double-count ZMWs when the serial fallback reruns them
+    outcomes: list[tuple[Failure, ConsensusResult | None]] = []
+    for z, p in enumerate(preps):
+        failure, status_counts, n_passes = gate_info[z]
+        if failure is not None:
+            outcomes.append((failure, None))
+            continue
+        nr = len(p.mapped)
+        if z in wide_pick:
+            i = wide_pick[z]
+            failure, result = _finish_zmw(
+                p, settings, wide.tpls[i], wide_qvs[i], wide_refine[i],
+                wide.zscores[i, :nr], wide_gz[i], status_counts,
+                n_passes, p.prep_ms + polish_ms)
+        else:
+            failure, result = _finish_zmw(
+                p, settings, polisher.tpls[z], qvs[z],
+                refine_results[z], polisher.zscores[z, :nr],
+                global_zs[z], status_counts, n_passes,
+                p.prep_ms + polish_ms)
+        outcomes.append((failure, result))
+    return outcomes
+
+
+def _pinned_batch_shapes(preps: Sequence[PreparedZmw],
+                         buckets: tuple[int, int, int] | None,
+                         min_z: int) -> tuple[tuple[int, int, int], int]:
+    """The effective (Imax, Jmax, R)/Z shapes the full batch polishes at:
+    quarantine sub-dispatches pin to these so they replay the parent's
+    compiled programs -- and, because band width W is a function of the
+    Jmax bucket, produce byte-identical results for surviving ZMWs.
+
+    zq/rq stay at their defaults (1): _polish_batch_arrow builds its
+    BatchPolisher without a mesh, so the parent's shapes were derived
+    with the same quanta.  A meshed dispatch path would need the mesh's
+    axis sizes threaded through here."""
+    from pbccs_tpu.parallel.batch import effective_shapes
+
+    imax, jmax, r, z = effective_shapes(
+        len(preps),
+        max(len(p.mapped) for p in preps),
+        max((len(m.seq) for p in preps for m in p.mapped), default=8),
+        max(len(p.css) for p in preps),
+        buckets=buckets, min_z=min_z)
+    return (imax, jmax, r), z
+
+
+def _guarded_dispatch(preps: Sequence[PreparedZmw],
+                      settings: ConsensusSettings, *,
+                      buckets: tuple[int, int, int] | None,
+                      min_z: int
+                      ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """One fault-domain batch dispatch: the chaos fault site
+    ("polish.dispatch", keyed by ZMW ids so poison specs can target one
+    ZMW), the hang watchdog (ambient deadline: --polishTimeout /
+    PBCCS_WATCHDOG_S; disabled by default), and a bounded retry on
+    transient device errors.  A watchdog timeout is never retried -- a
+    hang is not transient; the quarantine path isolates it instead."""
+    from pbccs_tpu.resilience import faults, retry, watchdog
+
+    ids = [p.chunk.id for p in preps]
+
+    def dispatch():
+        # the fault site sits INSIDE the watchdog scope: an injected
+        # delay exercises exactly the hung-dispatch recovery path
+        faults.maybe_fail("polish.dispatch", keys=ids)
+        return _polish_batch_arrow(preps, settings, buckets=buckets,
+                                   min_z=min_z)
+
+    def attempt():
+        return watchdog.run_with_deadline(dispatch, site="polish.dispatch")
+
+    return retry.DEVICE_RETRY.run(
+        attempt,
+        retry_on=lambda e: not isinstance(e, watchdog.WatchdogTimeout)
+        and retry.is_transient_device_error(e),
+        site="polish.dispatch")
+
+
 def polish_prepared_batch(preps: Sequence[PreparedZmw],
                           settings: ConsensusSettings | None = None, *,
                           buckets: tuple[int, int, int] | None = None,
-                          min_z: int = 1
+                          min_z: int = 1,
+                          on_error: str = "bisect"
                           ) -> list[tuple[Failure, ConsensusResult | None]]:
     """Polish a batch of prepared ZMWs in one lockstep BatchPolisher and
     return per-ZMW outcomes ALIGNED with `preps` -- the polish core shared
@@ -455,9 +704,15 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
     compiled-program menu instead of minting a fresh device loop per
     (batch size, read count) draw.
 
-    Any batch-path error falls back to the serial per-ZMW pipeline (fault
-    isolation, reference Consensus.h:543-548); a ZMW that fails even there
-    reports Failure.OTHER rather than poisoning its batch."""
+    A batch-path error no longer re-runs everything serially with the
+    exception discarded: the dispatch is guarded (hang watchdog,
+    transient-XLA retry) and a persistent failure routes to
+    resilience.quarantine -- with on_error="bisect" (default) the batch
+    is bisected in O(k log Z) pinned-shape re-dispatches to isolate the
+    k poison ZMW(s); on_error="serial" keeps the legacy whole-batch
+    serial fallback.  Either way a ZMW that fails even its serial rescue
+    is quarantined (logged + counted, optionally degraded to draft-only
+    consensus) instead of silently reporting Failure.OTHER."""
     settings = settings or ConsensusSettings()
     if settings.model == "quiver":
         # Quiver has no lockstep batch driver: it polishes per ZMW (its
@@ -466,182 +721,47 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
         for p in preps:
             try:
                 out.append(polish_prepared(p, settings))
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+            except Exception as e:  # noqa: BLE001 -- per-ZMW isolation
+                record_zmw_failure("polish.quiver", e, zmw=p.chunk.id)
                 out.append((Failure.OTHER, None))
         return out
     try:
-        from pbccs_tpu.runtime import timing
+        return _guarded_dispatch(preps, settings, buckets=buckets,
+                                 min_z=min_z)
+    except Exception as e:  # noqa: BLE001 -- quarantine the poison
+        from pbccs_tpu.resilience import quarantine
 
-        t0 = time.monotonic()
-        from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
-
-        tasks = [ZmwTask(p.chunk.id, p.css, np.asarray(p.chunk.snr),
-                         [m.seq for m in p.mapped],
-                         [m.strand for m in p.mapped],
-                         [m.tpl_start for m in p.mapped],
-                         [m.tpl_end for m in p.mapped]) for p in preps]
-        with obs_trace.span("polish.setup", zmws=len(preps)):
-            polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
-                                     buckets=buckets, min_z=min_z)
-        gate_info = []
-        for z, p in enumerate(preps):
-            gate_info.append(_read_gates(p, polisher.statuses[z], settings))
-        # ZMWs that shed reads to the alpha/beta mating gate retry in ONE
-        # wider-band (2x) sub-batch -- the batched analogue of the serial
-        # scorer's whole-scorer escalation (the reference rebands a
-        # mismatched pair up to 5 times before dropping,
-        # SimpleRecursor.cpp:642-691).  Keep-better-width per ZMW: a ZMW
-        # polishes at the wide band iff it MATES more reads there
-        # (status != ALPHABETAMISMATCH -- deliberately counting reads the
-        # wide band mates but the z-score gate then drops: the reference
-        # rebands to achieve alpha/beta agreement FIRST and applies the
-        # z-score gate to whatever mated, so reband-to-mate-then-gate is
-        # the parity semantics, not mates-that-survive-gating).  Otherwise
-        # it stays in the narrow batch with its drops (the serial retry's
-        # revert).  Either way the ZMW stays on the batched device path.
-        reband = sorted(z for z, p in enumerate(preps)
-                        if (polisher.statuses[z, : len(p.mapped)]
-                            == ADD_ALPHABETAMISMATCH).any())
-        wide = None
-        wide_pick: dict[int, int] = {}
-        if reband:
-            wcfg = dataclasses.replace(
-                polisher.config,
-                banding=dataclasses.replace(
-                    polisher.config.banding,
-                    # 2x the EFFECTIVE width (the W(L) schedule may have
-                    # shrunk the narrow batch below the configured width);
-                    # a non-default width passes through the schedule
-                    band_width=2 * polisher._W))
-            try:  # speculative build: any failure keeps the narrow batch
-                from pbccs_tpu.utils import next_pow2
-
-                # pin shapes to the narrow batch's buckets + pow2 Z so the
-                # data-dependent reband count doesn't mint fresh compiles
-                wide = BatchPolisher([tasks[z] for z in reband],
-                                     config=wcfg,
-                                     min_zscore=settings.min_zscore,
-                                     buckets=(polisher._Imax,
-                                              polisher._Jmax,
-                                              polisher._R),
-                                     min_z=next_pow2(len(reband), 4))
-            except Exception:  # noqa: BLE001
-                wide = None
-            if wide is not None:
-                for i, z in enumerate(reband):
-                    nr = len(preps[z].mapped)
-                    n_narrow = int((polisher.statuses[z, :nr]
-                                    != ADD_ALPHABETAMISMATCH).sum())
-                    n_wide = int((wide.statuses[i, :nr]
-                                  != ADD_ALPHABETAMISMATCH).sum())
-                    if n_wide > n_narrow:
-                        wide_pick[z] = i
-                        gate_info[z] = _read_gates(
-                            preps[z], wide.statuses[i], settings)
-            # banding observability: retry outcomes per batch (the
-            # reference's NumFlipFlops analogue at batch granularity)
-            from pbccs_tpu.runtime.logging import Logger
-
-            Logger.default().debug(
-                f"band retry: {len(reband)} ZMW(s) had mating failures at "
-                f"W={polisher._W}; "
-                f"{len(wide_pick)} adopted the 2x band, "
-                f"{len(reband) - len(wide_pick)} reverted")
-        # gate-failed ZMWs are excluded from refinement/QV (the serial path
-        # returns before polishing them); their batch slots stay idle
-        gate_failed = {z for z, g in enumerate(gate_info) if g[0] is not None}
-        skip = gate_failed | set(wide_pick)
-        # z-score statistics are reported for the draft template, before
-        # refinement (parity with the serial path)
-        global_zs = polisher.global_zscores()
-        with obs_trace.span("polish.refine", zmws=len(preps) - len(skip)):
-            refine_results = polisher.refine(settings.refine, skip=skip)
-        wide_refine = wide_qvs = wide_gz = None
-        if wide_pick:
-            try:  # the whole wide retry is speculative: any failure in its
-                # polish falls back to the narrow batch's completed results
-                # (with the narrow gates) instead of discarding the batch
-                wide_skip = {i for i in range(wide.n_zmws)
-                             if i not in {wi for z, wi in wide_pick.items()
-                                          if z not in gate_failed}}
-                wide_gz = wide.global_zscores()
-                wide_refine = wide.refine(settings.refine, skip=wide_skip)
-                wide_qvs = wide.consensus_qvs(
-                    skip=wide_skip | {i for i, r in enumerate(wide_refine)
-                                      if not r.converged})
-            except Exception:  # noqa: BLE001
-                retry = set(wide_pick)
-                for z in list(wide_pick):
-                    gate_info[z] = _read_gates(
-                        preps[z], polisher.statuses[z], settings)
-                wide_pick.clear()
-                gate_failed = {z for z, g in enumerate(gate_info)
-                               if g[0] is not None}
-                skip = gate_failed
-                # refine ONLY the formerly wide-routed ZMWs: the rest of
-                # the narrow batch already refined in the first pass, and
-                # re-running them would hand non-convergent ZMWs a second
-                # full iteration budget and rebuild their refine stats
-                todo = retry - gate_failed
-                if todo:
-                    retry_results = polisher.refine(
-                        settings.refine,
-                        skip=set(range(polisher.n_zmws)) - todo)
-                    for z in todo:
-                        refine_results[z] = retry_results[z]
-        # non-converged ZMWs are discarded by _finish_zmw; don't pay the QV
-        # sweep (the most expensive single pass) for them
-        skip = skip | {z for z, r in enumerate(refine_results)
-                       if not r.converged}
-        with obs_trace.span("polish.qv", zmws=len(preps) - len(skip)):
-            qvs = polisher.consensus_qvs(skip=skip)
-        polish_s = time.monotonic() - t0
-        timing.add_stage("polish", polish_s)
-        polish_ms = polish_s * 1e3 / max(len(preps), 1)
-
-        # outcomes accumulate into a local list so a mid-loop fault cannot
-        # double-count ZMWs when the serial fallback reruns them
-        outcomes: list[tuple[Failure, ConsensusResult | None]] = []
-        for z, p in enumerate(preps):
-            failure, status_counts, n_passes = gate_info[z]
-            if failure is not None:
-                outcomes.append((failure, None))
-                continue
-            nr = len(p.mapped)
-            if z in wide_pick:
-                i = wide_pick[z]
-                failure, result = _finish_zmw(
-                    p, settings, wide.tpls[i], wide_qvs[i], wide_refine[i],
-                    wide.zscores[i, :nr], wide_gz[i], status_counts,
-                    n_passes, p.prep_ms + polish_ms)
-            else:
-                failure, result = _finish_zmw(
-                    p, settings, polisher.tpls[z], qvs[z],
-                    refine_results[z], polisher.zscores[z, :nr],
-                    global_zs[z], status_counts, n_passes,
-                    p.prep_ms + polish_ms)
-            outcomes.append((failure, result))
-        return outcomes
-    except Exception:  # noqa: BLE001 -- isolate faults via the serial path
-        fallback: list[tuple[Failure, ConsensusResult | None]] = []
-        for p in preps:
-            try:
-                fallback.append(process_chunk(p.chunk, settings))
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
-                fallback.append((Failure.OTHER, None))
-        return fallback
+        if on_error == "serial":
+            # legacy fault isolation (reference Consensus.h:543-548):
+            # re-run every ZMW through the serial pipeline, each with
+            # the same rescue semantics bisection's singletons get
+            record_zmw_failure("polish.batch", e,
+                               zmw=f"batch[{len(preps)}]")
+            return [quarantine.serial_rescue(p, settings, e)
+                    for p in preps]
+        pin, z_pin = _pinned_batch_shapes(preps, buckets, min_z)
+        return quarantine.isolate(
+            preps,
+            lambda sub: _guarded_dispatch(sub, settings, buckets=pin,
+                                          min_z=z_pin),
+            settings, e)
 
 
 def process_chunks(chunks: Sequence[Chunk],
                    settings: ConsensusSettings | None = None,
-                   batch_polish: bool = True) -> ResultTally:
-    """Process a batch of ZMWs; exceptions become Other tallies and the batch
-    continues (reference Consensus.h:543-548).
+                   batch_polish: bool = True,
+                   on_error: str = "bisect") -> ResultTally:
+    """Process a batch of ZMWs; exceptions become Other tallies (logged +
+    counted, record_zmw_failure) and the batch continues (reference
+    Consensus.h:543-548).
 
     With batch_polish (the default), all ZMWs that survive the host stages
     polish together in one lockstep BatchPolisher (polish_prepared_batch) --
     the TPU execution model (one batched device program per refinement
-    round) instead of the reference's one-thread-per-ZMW loop."""
+    round) instead of the reference's one-thread-per-ZMW loop.  `on_error`
+    selects the batch-failure recovery (see polish_prepared_batch)."""
+    from pbccs_tpu.resilience import faults
+
     settings = settings or ConsensusSettings()
     tally = ResultTally()
     # the lockstep BatchPolisher is the Arrow device path; Quiver polishes
@@ -650,7 +770,8 @@ def process_chunks(chunks: Sequence[Chunk],
         for chunk in chunks:
             try:
                 failure, result = process_chunk(chunk, settings)
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+            except Exception as e:  # noqa: BLE001 -- per-ZMW isolation
+                record_zmw_failure("zmw", e, zmw=chunk.id)
                 tally.tally(Failure.OTHER)
                 continue
             tally.tally(failure)
@@ -664,8 +785,10 @@ def process_chunks(chunks: Sequence[Chunk],
     with timing.stage("draft"):
         for chunk in chunks:
             try:
+                faults.maybe_fail("prep.zmw", keys=[chunk.id])
                 failure, prep = prepare_chunk(chunk, settings)
-            except Exception:  # noqa: BLE001 -- per-ZMW fault isolation
+            except Exception as e:  # noqa: BLE001 -- per-ZMW isolation
+                record_zmw_failure("prepare", e, zmw=chunk.id)
                 tally.tally(Failure.OTHER)
                 continue
             if failure is not None:
@@ -676,7 +799,8 @@ def process_chunks(chunks: Sequence[Chunk],
         return tally
 
     with obs_trace.span("polish", zmws=len(preps)):
-        outcomes = polish_prepared_batch(preps, settings)
+        outcomes = polish_prepared_batch(preps, settings,
+                                         on_error=on_error)
     for failure, result in outcomes:
         tally.tally(failure)
         if result is not None:

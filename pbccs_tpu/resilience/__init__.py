@@ -1,0 +1,29 @@
+"""Resilience subsystem shared by the batch CLI and the serving engine.
+
+The reference `ccs` tolerates bad input per ZMW (one thread per ZMW:
+a poison ZMW fails alone, Consensus.h:543-548).  The TPU port fuses many
+ZMWs into one lockstep device program, so fault tolerance has to be
+re-engineered at batch granularity:
+
+  * `faults`     -- seedable site-based fault injection (chaos testing:
+                    deterministic device errors / hangs / corruption at
+                    named sites, enabled via PBCCS_FAULTS or --faults);
+  * `retry`      -- RetryPolicy (exponential backoff + deterministic
+                    jitter, deadline-aware) for transient device errors
+                    and `overloaded` serve backpressure;
+  * `quarantine` -- on batch-polish failure, bisect the prepared batch
+                    (log2 re-dispatches) to isolate the poison ZMW(s),
+                    optionally degrading them to draft-only consensus
+                    instead of dropping them as Failure.OTHER;
+  * `watchdog`   -- deadline wrapper turning a hung device dispatch into
+                    a structured WatchdogTimeout (batch: quarantine
+                    path; serve: failed replies, engine stays up);
+  * `checkpoint` -- per-chunk journal for the offline CLI (`--resume`):
+                    a killed run restarts from the last completed chunk
+                    with an identical final tally and output.
+
+Metric names (obs registry): ccs_faults_injected_total{site,kind},
+ccs_retries_total{site}, ccs_quarantined_zmws_total,
+ccs_degraded_zmws_total, ccs_watchdog_timeouts_total{site},
+ccs_checkpoint_records_total{kind}, ccs_zmw_failures_total{stage,exc}.
+"""

@@ -1,0 +1,180 @@
+"""Poison-ZMW isolation: bisect a failed polish batch, quarantine the
+culprit(s), optionally degrade them to draft-only consensus.
+
+The reference polishes one ZMW per thread, so a poison ZMW fails alone
+(Consensus.h:543-548).  Our lockstep batch fuses Z ZMWs into one device
+program, and before this module the recovery was to silently re-run the
+WHOLE batch serially -- O(Z) per-ZMW polishes for one bad input, with
+the original exception discarded.  Bisection instead isolates k poison
+ZMWs in O(k log Z) re-dispatches, and because sub-batches reuse the
+parent batch's pinned (Imax, Jmax, R)/Z bucket shapes they replay
+already-compiled device programs (and produce byte-identical results
+for the surviving ZMWs -- band width W is a function of the bucket).
+
+An isolated singleton gets one serial-pipeline rescue (the per-ZMW path
+the reference uses, parity-pinned against the batch path); only if that
+also fails is the ZMW quarantined:
+
+  * default: tallied Failure.OTHER (the reference's outcome), now with
+    the exception class + traceback logged instead of discarded;
+  * with ConsensusSettings.degrade_quarantined: emitted as a DRAFT-ONLY
+    consensus -- the POA draft sequence with QVs capped at DRAFT_QV_CAP
+    and ConsensusResult.draft_only set (the CLI writes a `df` BAM tag)
+    -- so hour-long production runs keep the read instead of dropping it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+import numpy as np
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.runtime.logging import Logger
+
+P = TypeVar("P")   # PreparedZmw (duck-typed; pipeline imports stay lazy)
+
+_reg = default_registry()
+_m_quarantined = _reg.counter(
+    "ccs_quarantined_zmws_total",
+    "ZMWs isolated by bisection whose serial rescue also failed")
+_m_degraded = _reg.counter(
+    "ccs_degraded_zmws_total",
+    "Quarantined ZMWs emitted as draft-only consensus")
+_m_bisect = _reg.counter(
+    "ccs_quarantine_bisect_dispatches_total",
+    "Extra sub-batch dispatches spent isolating poison ZMWs")
+
+# QV ceiling for draft-only consensus: a POA draft is typically ~Q10-Q20
+# accurate; capping at Q10 keeps downstream consumers from mistaking an
+# unpolished read for a polished one (predicted accuracy reports 0.90)
+DRAFT_QV_CAP = 10
+
+
+def degrade_to_draft(prep, settings):
+    """Draft-only consensus for a quarantined ZMW: the POA draft sequence
+    with capped QVs, marked draft_only (-> `df` tag at emission)."""
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.models.arrow.refine import predicted_accuracy
+    from pbccs_tpu.pipeline import ConsensusResult, Failure
+
+    qvs = np.full(len(prep.css), DRAFT_QV_CAP, np.float64)
+    n_passes = sum(1 for m in prep.mapped if m.is_full_pass)
+    nan = float("nan")
+    return Failure.SUCCESS, ConsensusResult(
+        id=prep.chunk.id,
+        sequence=decode_bases(prep.css),
+        qvs=qvs,
+        num_passes=n_passes,
+        predicted_accuracy=predicted_accuracy(qvs),
+        global_zscore=nan,
+        avg_zscore=nan,
+        zscores=np.full(len(prep.mapped), nan),
+        status_counts=[0] * 5,
+        mutations_tested=0,
+        mutations_applied=0,
+        snr=np.asarray(prep.chunk.snr),
+        elapsed_ms=prep.prep_ms,
+        draft_only=True)
+
+
+def quarantine_outcome(prep, settings, exc: BaseException):
+    """The terminal outcome for a ZMW whose batch AND serial polishes
+    failed: draft-only degradation when enabled, else Failure.OTHER."""
+    from pbccs_tpu.pipeline import Failure
+
+    _m_quarantined.inc()
+    log = Logger.default()
+    if getattr(settings, "degrade_quarantined", False):
+        try:
+            outcome = degrade_to_draft(prep, settings)
+        except Exception as e:  # noqa: BLE001 -- degradation must never
+            # re-poison the batch; fall through to the OTHER tally
+            log.warn(f"ZMW {prep.chunk.id}: draft degradation failed "
+                     f"({e!r}); dropping as Other")
+            return Failure.OTHER, None
+        _m_degraded.inc()
+        log.warn(f"ZMW {prep.chunk.id}: quarantined ({type(exc).__name__}); "
+                 f"emitting draft-only consensus (QV cap {DRAFT_QV_CAP})")
+        return outcome
+    log.warn(f"ZMW {prep.chunk.id}: quarantined ({type(exc).__name__}); "
+             "dropped as Other")
+    return Failure.OTHER, None
+
+
+def serial_rescue(prep, settings, batch_exc: BaseException):
+    """One isolated singleton: the reference's per-ZMW serial path
+    (parity-pinned against the batch path, so a rescued ZMW's output is
+    byte-identical), under the same ambient watchdog deadline as the
+    batch dispatch -- a PERSISTENTLY hung poison ZMW must quarantine,
+    not stall the run at its last re-polish.  The fault site fires here
+    too: a poison ZMW is poison however it is polished.  Shared by the
+    bisection path (below) and pipeline's legacy on_error="serial"
+    loop, so the two fallback modes cannot drift."""
+    from pbccs_tpu import pipeline
+    from pbccs_tpu.resilience import faults
+    from pbccs_tpu.resilience.watchdog import run_with_deadline
+
+    def polish_one():
+        faults.maybe_fail("polish.dispatch", keys=[prep.chunk.id])
+        return pipeline.process_chunk(prep.chunk, settings)
+
+    try:
+        return run_with_deadline(polish_one, site="polish.serial")
+    except Exception as e:  # noqa: BLE001 -- the quarantine boundary
+        pipeline.record_zmw_failure("polish.serial", e, zmw=prep.chunk.id)
+        return quarantine_outcome(prep, settings, e)
+
+
+def isolate(preps: Sequence[P],
+            dispatch: Callable[[Sequence[P]], list],
+            settings,
+            first_error: BaseException,
+            serial_fn: Callable | None = None) -> list:
+    """Bisect `preps` (whose full-batch dispatch already raised
+    `first_error`) down to the poison ZMW(s).
+
+    dispatch(sub_preps) returns outcomes aligned with its input and
+    raises on failure; it should pin bucket shapes to the PARENT batch's
+    so every sub-dispatch replays compiled programs.  `serial_fn(prep,
+    settings, exc)` handles an isolated singleton (default:
+    serial_rescue; tests inject stubs).  Returns outcomes aligned with
+    `preps`."""
+    from pbccs_tpu import pipeline
+
+    serial_fn = serial_fn or serial_rescue
+    log = Logger.default()
+    n = len(preps)
+    out: list = [None] * n
+    pipeline.record_zmw_failure("polish.batch", first_error,
+                                zmw=f"batch[{n}]")
+    if n == 1:
+        out[0] = serial_fn(preps[0], settings, first_error)
+        return out
+    mid = n // 2
+    groups: list[list[int]] = [list(range(mid, n)), list(range(mid))]
+    while groups:
+        grp = groups.pop()
+        if len(grp) == 1:
+            out[grp[0]] = serial_fn(preps[grp[0]], settings,
+                                    first_error)
+            continue
+        _m_bisect.inc()
+        try:
+            results = dispatch([preps[i] for i in grp])
+        except Exception as e:  # noqa: BLE001 -- keep splitting
+            pipeline.record_zmw_failure("polish.batch", e,
+                                        zmw=f"batch[{len(grp)}]")
+            m = len(grp) // 2
+            groups.append(grp[m:])
+            groups.append(grp[:m])
+            continue
+        for i, r in zip(grp, results):
+            out[i] = r
+    bad = sum(1 for o in out if o is None)
+    if bad:  # defensive: dispatch returned short -- fail those ZMWs loudly
+        from pbccs_tpu.pipeline import Failure
+
+        log.error(f"quarantine bisection left {bad} ZMW(s) unresolved")
+        out = [o if o is not None else (Failure.OTHER, None) for o in out]
+    return out

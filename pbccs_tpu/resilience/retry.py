@@ -1,0 +1,134 @@
+"""RetryPolicy: exponential backoff + deterministic jitter, deadline-aware.
+
+Replaces the ad-hoc retry loops that grew around backpressure and flaky
+devices.  Two call sites define the contract:
+
+  * the serve client retries `overloaded` rejections (bounded attempts,
+    jittered backoff so a thundering herd decorrelates);
+  * device dispatch retries TRANSIENT XLA errors (allocator pressure,
+    preempted/unavailable device) before the quarantine machinery treats
+    the batch as poisoned.
+
+Jitter is drawn from a seedable RNG so chaos runs are reproducible; the
+optional deadline bounds total wall time INCLUDING the next sleep (a
+retry that cannot finish before the deadline is not attempted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterator, TypeVar
+
+import numpy as np
+
+from pbccs_tpu.obs.metrics import default_registry
+
+T = TypeVar("T")
+
+_reg = default_registry()
+
+
+def _retry_counter(site: str):
+    return _reg.counter("ccs_retries_total",
+                        "Retries performed by RetryPolicy.run", site=site)
+
+
+class RetriesExhausted(RuntimeError):
+    """Raised by RetryPolicy.run when attempts/deadline run out; __cause__
+    is the last underlying error."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: delay_k = base * multiplier^k, capped at
+    max_delay, each +/- jitter fraction."""
+
+    max_attempts: int = 3          # total attempts (1 = no retry)
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25           # +/- fraction of the nominal delay
+    deadline_s: float | None = None  # total wall budget across attempts
+
+    def delays(self, rng: np.random.Generator | None = None
+               ) -> Iterator[float]:
+        """The backoff sequence (max_attempts - 1 sleeps)."""
+        rng = rng or np.random.default_rng()
+        d = self.base_delay_s
+        for _ in range(max(self.max_attempts - 1, 0)):
+            j = rng.uniform(-self.jitter, self.jitter) if self.jitter else 0.0
+            yield max(0.0, d * (1.0 + j))
+            d = min(d * self.multiplier, self.max_delay_s)
+
+    def run(self, fn: Callable[[], T], *,
+            retry_on: Callable[[BaseException], bool],
+            site: str = "retry",
+            rng: np.random.Generator | None = None,
+            sleep: Callable[[float], None] = time.sleep) -> T:
+        """Call fn() with retries on errors retry_on() accepts.
+
+        Non-retryable errors propagate untouched.  When attempts or the
+        deadline run out, raises RetriesExhausted from the last error
+        (so callers can distinguish "gave up" from "not retryable")."""
+        counter = _retry_counter(site)
+        t0 = time.monotonic()
+        last: BaseException | None = None
+        delays = self.delays(rng)
+        attempt = 0
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 -- filtered below
+                if not retry_on(e):
+                    raise
+                last = e
+            delay = next(delays, None)
+            if delay is None:
+                break
+            if self.deadline_s is not None and \
+                    time.monotonic() - t0 + delay > self.deadline_s:
+                break
+            counter.inc()
+            sleep(delay)
+        # report what actually stopped us: the attempt budget or the
+        # deadline (whoever debugs a shedding fleet needs the real count)
+        elapsed = time.monotonic() - t0
+        why = (f"deadline {self.deadline_s:g}s exceeded"
+               if attempt < self.max_attempts
+               else f"attempt budget {self.max_attempts} spent")
+        raise RetriesExhausted(
+            f"{site}: gave up after {attempt} attempt(s) in "
+            f"{elapsed:.1f}s ({why})") from last
+
+
+# the device-dispatch default: fast, few attempts (a lockstep batch is
+# expensive to sit on), generous cap for allocator back-off
+DEVICE_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                           max_delay_s=5.0)
+
+# the serve client's overloaded-backpressure default: patient, DEADLINE-
+# governed (the attempt bound is a backstop, not the limiter) -- a cold
+# engine legitimately holds its pool for a ~minute-long first compile,
+# and a client that gives up after seconds of backoff sheds load the
+# server was about to absorb
+OVERLOADED_RETRY = RetryPolicy(max_attempts=128, base_delay_s=0.05,
+                               max_delay_s=2.0, deadline_s=120.0)
+
+# message markers identifying a transient device-side failure.  XLA wraps
+# everything in XlaRuntimeError; the status code survives in the text.
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED",
+                      "DEADLINE_EXCEEDED", "transient")
+
+
+def is_transient_device_error(exc: BaseException) -> bool:
+    """True when exc looks like a retryable device/runtime hiccup rather
+    than a poison input or a code bug.  Matches by type name (jaxlib's
+    XlaRuntimeError is not importable from a stable path) + by status
+    marker in the message, so injected faults with a "transient" marker
+    classify identically to the real thing."""
+    name = type(exc).__name__
+    text = str(exc)
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return True
+    return name == "XlaRuntimeError" and "INVALID_ARGUMENT" not in text

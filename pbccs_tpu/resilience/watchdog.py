@@ -1,0 +1,105 @@
+"""Deadline wrapper around device dispatch/compile.
+
+A hung device program (bad interconnect, runaway compile, a pathological
+input driving an unbounded loop) would otherwise stall the whole driver:
+the batch CLI forever, the serve engine's polish worker silently.  The
+watchdog runs the guarded callable on a disposable worker thread and
+bounds the wait; on expiry it raises a structured WatchdogTimeout in the
+CALLER, who recovers on the normal failure path (batch: quarantine
+bisection; serve: fail this batch's replies, engine stays up).
+
+Python cannot kill the hung thread -- it is abandoned (daemon) and its
+eventual result, if any, is discarded.  That leaks the thread (and
+whatever device program it is blocked in) but keeps the process alive
+and serving, which is the contract.  A late exception from an abandoned
+callable is logged at debug, never raised.
+
+The default deadline comes from PBCCS_WATCHDOG_S (0/unset = disabled) or
+configure() (the CLI's --polishTimeout flag); the serve engine passes
+its own ServeConfig.polish_timeout_ms explicitly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, TypeVar
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.runtime.logging import Logger
+
+T = TypeVar("T")
+
+_reg = default_registry()
+
+
+class WatchdogTimeout(TimeoutError):
+    """A guarded callable exceeded its deadline (structured: site + s)."""
+
+    def __init__(self, site: str, timeout_s: float):
+        super().__init__(
+            f"watchdog: {site or 'callable'} exceeded {timeout_s:g}s deadline")
+        self.site = site
+        self.timeout_s = timeout_s
+
+
+_default_deadline: float | None = None
+
+
+def configure(deadline_s: float | None) -> None:
+    """Set the process default deadline (None reverts to the env)."""
+    global _default_deadline
+    _default_deadline = deadline_s
+
+
+def default_deadline_s() -> float:
+    """The ambient dispatch deadline: configure() value, else
+    PBCCS_WATCHDOG_S, else 0 (disabled)."""
+    if _default_deadline is not None:
+        return _default_deadline
+    try:
+        return float(os.environ.get("PBCCS_WATCHDOG_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def run_with_deadline(fn: Callable[[], T], timeout_s: float | None = None,
+                      *, site: str = "") -> T:
+    """Run fn() with a deadline; timeout_s None uses the ambient default,
+    and <= 0 disables the wrapper entirely (fn runs on this thread)."""
+    if timeout_s is None:
+        timeout_s = default_deadline_s()
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+
+    done = threading.Event()
+    abandoned = threading.Event()
+    box: list = []          # [("ok", result)] or [("err", exc)]
+
+    def target() -> None:
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 -- re-raised by the
+            # caller, or logged at debug if it already timed out
+            box.append(("err", e))
+            if abandoned.is_set():
+                Logger.default().debug(
+                    f"watchdog[{site}]: abandoned callable failed late: "
+                    f"{e!r}")
+        done.set()
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"pbccs-watchdog-{site or 'anon'}")
+    t.start()
+    if not done.wait(timeout_s):
+        abandoned.set()
+        _reg.counter("ccs_watchdog_timeouts_total",
+                     "Guarded callables that exceeded their deadline",
+                     site=site or "anon").inc()
+        Logger.default().warn(
+            f"watchdog: {site or 'callable'} still running after "
+            f"{timeout_s:g}s; abandoning it")
+        raise WatchdogTimeout(site, timeout_s)
+    status, payload = box[0]
+    if status == "err":
+        raise payload
+    return payload

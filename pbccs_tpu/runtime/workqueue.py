@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterator, TypeVar
 
@@ -37,6 +38,8 @@ _produced = _reg.counter("ccs_workqueue_produced_total",
                          "Tasks submitted to the work queue")
 _consumed = _reg.counter("ccs_workqueue_consumed_total",
                          "Task results consumed in order")
+_failures = _reg.counter("ccs_workqueue_task_failures_total",
+                         "Worker tasks that raised (propagated)")
 
 
 class WorkQueue:
@@ -76,8 +79,23 @@ class WorkQueue:
 
         def run():
             try:
+                from pbccs_tpu.resilience import faults
+
+                # chaos site: a worker-task crash exercises the
+                # propagate-to-producer/consumer path (and, under the
+                # CLI's --checkpoint, the resume-after-crash path)
+                faults.maybe_fail("workqueue.task")
                 return fn(*args, **kwargs)
             except BaseException as e:
+                # a propagated task failure aborts the whole pipeline;
+                # make sure the log carries the traceback even if the
+                # driver only surfaces the message
+                _failures.inc()
+                from pbccs_tpu.runtime.logging import Logger
+                Logger.default().error(
+                    "work queue task failed: "
+                    + "".join(traceback.format_exception(
+                        type(e), e, e.__traceback__)))
                 # publish the error BEFORE the flag: a producer/consumer
                 # woken by _failed must never observe _first_error unset
                 with self._error_lock:

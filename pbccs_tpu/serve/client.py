@@ -11,12 +11,15 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Any, Sequence
+from typing import TYPE_CHECKING, Any, Sequence
 
 import numpy as np
 
 from pbccs_tpu.pipeline import Chunk
 from pbccs_tpu.serve import protocol
+
+if TYPE_CHECKING:
+    from pbccs_tpu.resilience.retry import RetryPolicy
 
 
 class ServeError(RuntimeError):
@@ -143,6 +146,33 @@ class CcsClient:
         zmw = {"id": zmw_id, "snr": snr,
                "reads": [{"seq": s} for s in reads]}
         return self.submit_wire(zmw, deadline_ms)
+
+    def submit_with_retry(self, zmw: Chunk | dict[str, Any],
+                          deadline_ms: float | None = None,
+                          policy: "RetryPolicy | None" = None,
+                          reply_timeout: float | None = 600.0
+                          ) -> dict[str, Any]:
+        """Submit one ZMW, honoring `overloaded` backpressure: an
+        overloaded rejection re-submits with jittered exponential backoff
+        (resilience.retry.OVERLOADED_RETRY by default -- bounded attempts
+        AND a wall deadline), so a client fleet sheds load instead of
+        hammering a full engine.  Blocks until the final reply; returns
+        the reply message.  Non-overloaded errors raise immediately;
+        exhausted retries raise retry.RetriesExhausted from the last
+        overloaded rejection."""
+        from pbccs_tpu.resilience import retry as retry_mod
+
+        policy = policy or retry_mod.OVERLOADED_RETRY
+        wire = protocol.chunk_to_wire(zmw) if isinstance(zmw, Chunk) else zmw
+
+        def attempt() -> dict[str, Any]:
+            return self.submit_wire(wire, deadline_ms).reply(reply_timeout)
+
+        return policy.run(
+            attempt,
+            retry_on=lambda e: isinstance(e, ServeError)
+            and e.code == protocol.ERR_OVERLOADED,
+            site="client.submit")
 
     def status(self, timeout: float | None = 30.0) -> dict[str, Any]:
         handle = PendingReply(self._next_id())

@@ -115,6 +115,11 @@ class ServeConfig:
     # the offline CLI's read-score input gate (cli.py --minReadScore),
     # applied at admission so serve and offline see the same read sets
     min_read_score: float = 0.75
+    # watchdog deadline per polish batch (resilience.watchdog): a hung
+    # device program becomes a structured timeout error on THAT batch's
+    # requests and the engine keeps serving.  0 disables.  Size it well
+    # above a worst-case polish incl. quarantine bisection re-dispatches.
+    polish_timeout_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -392,11 +397,19 @@ class CcsEngine:
             reqs = [item.payload[0] for item in batch.items]
             preps = [item.payload[1] for item in batch.items]
             try:
+                from pbccs_tpu.resilience.watchdog import run_with_deadline
+
                 with obs_trace.span("serve.polish", bucket=str(batch.key),
                                     zmws=len(batch.items),
                                     reason=batch.reason), \
                         timing.stage("serve.polish"):
-                    outcomes = self._polish_fn(preps, self.settings)
+                    # the watchdog turns a hung device program into a
+                    # structured timeout on THIS batch's requests; the
+                    # engine (and its polish worker) keep serving
+                    outcomes = run_with_deadline(
+                        lambda: self._polish_fn(preps, self.settings),
+                        self.config.polish_timeout_ms / 1e3,
+                        site="serve.polish")
                 if len(outcomes) != len(reqs):
                     raise RuntimeError(
                         f"polish returned {len(outcomes)} outcomes for "
@@ -494,7 +507,9 @@ class CcsEngine:
         out = {}
         for (name, labels), (kind, val) in sorted(_reg.snapshot().items()):
             if kind == "histogram" or not name.startswith(
-                    ("ccs_serve_", "ccs_batch_", "ccs_device_")):
+                    ("ccs_serve_", "ccs_batch_", "ccs_device_",
+                     "ccs_retries_", "ccs_quarantine", "ccs_degraded_",
+                     "ccs_watchdog_", "ccs_faults_")):
                 continue
             suffix = "{%s}" % ",".join(
                 f"{k}={v}" for k, v in labels) if labels else ""

@@ -179,6 +179,10 @@ def result_to_wire(request_id: Any, zmw_id: str, failure: Failure,
             avg_zscore=(float(result.avg_zscore)
                         if np.isfinite(result.avg_zscore) else None),
         )
+        if result.draft_only:
+            # quarantine degradation: the sequence is the unpolished POA
+            # draft with capped QVs (resilience.quarantine)
+            msg["draft_only"] = True
     return msg
 
 

@@ -283,10 +283,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadlineMs", type=float,
                    default=defaults.default_deadline_ms,
                    help="Default per-request deadline. Default = %(default)s")
-    # consensus knobs shared (definition and defaults) with the offline CLI
-    from pbccs_tpu.cli import add_consensus_args
+    # consensus + resilience knobs shared (definition and defaults) with
+    # the offline CLI; serve maps --polishTimeout to the ENGINE-level
+    # watchdog (ServeConfig.polish_timeout_ms) rather than the ambient
+    # per-dispatch one, so a single timer governs each polish batch
+    from pbccs_tpu.cli import add_consensus_args, add_resilience_args
 
     add_consensus_args(p)
+    add_resilience_args(p)
     p.add_argument("--logLevel", default="INFO")
     return p
 
@@ -294,6 +298,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
 def run_serve(argv: list[str] | None = None) -> int:
     """`ccs serve` entry point (dispatched from pbccs_tpu.cli)."""
     args = build_serve_parser().parse_args(argv)
+
+    from pbccs_tpu.resilience import faults
+
+    if args.faults is not None:
+        faults.configure(args.faults, seed=args.faultSeed)
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 
@@ -309,7 +318,8 @@ def run_serve(argv: list[str] | None = None) -> int:
         max_pending=args.maxPending,
         prep_workers=args.prepWorkers,
         default_deadline_ms=args.deadlineMs,
-        min_read_score=args.minReadScore)
+        min_read_score=args.minReadScore,
+        polish_timeout_ms=(args.polishTimeout or 0) * 1e3)
 
     with CcsEngine(settings, config, logger=log) as engine:
         server = CcsServer(engine, args.host, args.port, logger=log)

@@ -1,0 +1,573 @@
+"""Resilience subsystem tests: fault injection, retry, quarantine
+bisection, watchdog, checkpoint journal, and the serve-side retry/
+watchdog integrations.
+
+The unit layers (faults/retry/watchdog/checkpoint/bisection control
+flow) run with stubs and no device work; two pipeline-level tests pin
+the batch-fallback parity contract -- a poisoned batch must yield
+byte-identical results for every surviving ZMW, on both the bisection
+path and the legacy serial path -- against the real polish core.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.pipeline import (
+    Chunk,
+    ConsensusResult,
+    ConsensusSettings,
+    Failure,
+    MappedRead,
+    PreparedZmw,
+    Subread,
+)
+from pbccs_tpu.resilience import checkpoint, faults, quarantine, retry, watchdog
+from pbccs_tpu.resilience.faults import FaultSpecError, InjectedFault
+
+# ----------------------------------------------------------------- helpers
+
+
+def make_chunk(zmw_id="m/1", n_reads=4, length=20):
+    seq = np.arange(length, dtype=np.int8) % 4
+    return Chunk(zmw_id,
+                 [Subread(f"{zmw_id}/{i}", seq.copy())
+                  for i in range(n_reads)],
+                 np.full(4, 8.0))
+
+
+def make_prep(zmw_id="m/1", tpl_len=24, n_reads=3):
+    chunk = make_chunk(zmw_id, n_reads=n_reads, length=tpl_len)
+    css = np.arange(tpl_len, dtype=np.int8) % 4
+    mapped = [MappedRead(r.id, r.seq, 0, 0, tpl_len, True)
+              for r in chunk.reads]
+    return PreparedZmw(chunk, css, mapped, n_reads, 0, 1.5)
+
+
+def fake_result(zmw_id, sequence="ACGT"):
+    return ConsensusResult(
+        id=zmw_id, sequence=sequence,
+        qvs=np.full(len(sequence), 40.0), num_passes=4,
+        predicted_accuracy=0.999, global_zscore=0.1, avg_zscore=0.2,
+        zscores=np.array([0.5, np.nan]), status_counts=[2, 0, 1, 0, 0],
+        mutations_tested=7, mutations_applied=3, snr=np.full(4, 8.0),
+        elapsed_ms=1.25)
+
+
+# ------------------------------------------------------------------- faults
+
+
+class TestFaults:
+    def test_parse_grammar(self):
+        specs = faults.parse_faults(
+            "polish.dispatch:error~m/3,prep.zmw:delay=0.5@2*1,"
+            "checkpoint.record:corrupt%0.25")
+        assert [s.site for s in specs] == ["polish.dispatch", "prep.zmw",
+                                           "checkpoint.record"]
+        assert specs[0].kind == "error" and specs[0].key == "m/3"
+        assert specs[1].kind == "delay" and specs[1].delay_s == 0.5
+        assert specs[1].at == 2 and specs[1].times == 1
+        assert specs[2].kind == "corrupt" and specs[2].prob == 0.25
+
+    @pytest.mark.parametrize("bad", ["nosite", "site:frobnicate",
+                                     "site:error@x"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(FaultSpecError):
+            faults.parse_faults(bad)
+
+    def test_key_selects_poison(self):
+        inj = faults.FaultInjector("polish.dispatch:error~m/2")
+        inj.maybe_fail("polish.dispatch", keys=["m/1", "m/3"])  # no match
+        inj.maybe_fail("other.site", keys=["m/2"])              # other site
+        with pytest.raises(InjectedFault):
+            inj.maybe_fail("polish.dispatch", keys=["m/1", "m/2"])
+        assert inj.fired("polish.dispatch") == 1
+
+    def test_at_and_times_modifiers(self):
+        inj = faults.FaultInjector("s:error@2*1")
+        inj.maybe_fail("s")                    # call 1: not yet
+        with pytest.raises(InjectedFault):
+            inj.maybe_fail("s")                # call 2: fires
+        inj.maybe_fail("s")                    # call 3: exhausted
+        assert inj.fired() == 1
+
+    def test_probability_is_seed_deterministic(self):
+        def fire_pattern(seed):
+            inj = faults.FaultInjector("s:error%0.5", seed=seed)
+            pat = []
+            for _ in range(20):
+                try:
+                    inj.maybe_fail("s")
+                    pat.append(0)
+                except InjectedFault:
+                    pat.append(1)
+            return pat
+
+        assert fire_pattern(7) == fire_pattern(7)
+        assert fire_pattern(7) != fire_pattern(8)
+        assert 0 < sum(fire_pattern(7)) < 20
+
+    def test_corrupt_bytes_and_array(self):
+        inj = faults.FaultInjector("c:corrupt")
+        data = b"0123456789"
+        bad = inj.corrupt("c", data)
+        assert bad != data and len(bad) == len(data)
+        arr = np.zeros(8, np.int8)
+        bad_arr = inj.corrupt("c", arr)
+        assert (bad_arr != arr).any()
+        assert (arr == 0).all()  # input untouched
+        # unarmed site is identity
+        assert inj.corrupt("other", data) is data
+
+    def test_module_level_noop_and_active_scope(self):
+        faults.maybe_fail("anywhere", keys=["x"])  # no injector: no-op
+        with faults.active("s:error"):
+            with pytest.raises(InjectedFault):
+                faults.maybe_fail("s")
+        faults.maybe_fail("s")  # restored
+
+    def test_injected_fault_metric(self):
+        scope = default_registry().scope()
+        with faults.active("s:error*2"):
+            for _ in range(3):
+                try:
+                    faults.maybe_fail("s")
+                except InjectedFault:
+                    pass
+        assert scope.counter_value("ccs_faults_injected_total",
+                                   site="s", kind="error") == 2
+
+
+# -------------------------------------------------------------------- retry
+
+
+class TestRetry:
+    def test_delays_backoff_and_cap(self):
+        pol = retry.RetryPolicy(max_attempts=5, base_delay_s=0.1,
+                                max_delay_s=0.3, multiplier=2.0,
+                                jitter=0.0)
+        assert list(pol.delays()) == [0.1, 0.2, 0.3, 0.3]
+
+    def test_jitter_is_rng_deterministic(self):
+        pol = retry.RetryPolicy(max_attempts=4, jitter=0.5)
+        a = list(pol.delays(np.random.default_rng(3)))
+        b = list(pol.delays(np.random.default_rng(3)))
+        assert a == b
+        assert a != list(pol.delays(np.random.default_rng(4)))
+
+    def test_run_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient blip")
+            return "ok"
+
+        scope = default_registry().scope()
+        pol = retry.RetryPolicy(max_attempts=4, base_delay_s=0.0)
+        assert pol.run(flaky, retry_on=lambda e: "transient" in str(e),
+                       site="test.retry") == "ok"
+        assert len(calls) == 3
+        assert scope.counter_value("ccs_retries_total",
+                                   site="test.retry") == 2
+
+    def test_run_propagates_non_retryable(self):
+        pol = retry.RetryPolicy(max_attempts=5, base_delay_s=0.0)
+        with pytest.raises(ValueError):
+            pol.run(lambda: (_ for _ in ()).throw(ValueError("poison")),
+                    retry_on=lambda e: False)
+
+    def test_run_exhausts_with_cause(self):
+        pol = retry.RetryPolicy(max_attempts=2, base_delay_s=0.0)
+        with pytest.raises(retry.RetriesExhausted) as ei:
+            pol.run(lambda: (_ for _ in ()).throw(RuntimeError("always")),
+                    retry_on=lambda e: True)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+
+    def test_deadline_bounds_total_wall(self):
+        slept = []
+        pol = retry.RetryPolicy(max_attempts=10, base_delay_s=5.0,
+                                jitter=0.0, deadline_s=1.0)
+        with pytest.raises(retry.RetriesExhausted):
+            pol.run(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                    retry_on=lambda e: True, sleep=slept.append)
+        assert slept == []  # first 5 s backoff already busts the deadline
+
+    def test_transient_classifier(self):
+        assert retry.is_transient_device_error(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert retry.is_transient_device_error(
+            InjectedFault("polish.dispatch", "transient"))
+        assert not retry.is_transient_device_error(
+            ValueError("bad template"))
+        assert not retry.is_transient_device_error(
+            watchdog.WatchdogTimeout("polish.dispatch", 3.0))
+
+
+# ----------------------------------------------------------------- watchdog
+
+
+class TestWatchdog:
+    def test_disabled_runs_inline(self):
+        tid = threading.get_ident()
+        assert watchdog.run_with_deadline(
+            threading.get_ident, 0) == tid
+
+    def test_result_and_exception_pass_through(self):
+        assert watchdog.run_with_deadline(lambda: 42, 5.0) == 42
+        with pytest.raises(ValueError):
+            watchdog.run_with_deadline(
+                lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0)
+
+    def test_timeout_raises_structured(self):
+        scope = default_registry().scope()
+        release = threading.Event()
+        with pytest.raises(watchdog.WatchdogTimeout) as ei:
+            watchdog.run_with_deadline(lambda: release.wait(30.0), 0.1,
+                                       site="test.hang")
+        release.set()  # unblock the abandoned thread
+        assert ei.value.site == "test.hang"
+        assert scope.counter_value("ccs_watchdog_timeouts_total",
+                                   site="test.hang") == 1
+
+    def test_configure_overrides_env(self):
+        watchdog.configure(1.5)
+        try:
+            assert watchdog.default_deadline_s() == 1.5
+        finally:
+            watchdog.configure(None)
+        assert os.environ.get("PBCCS_WATCHDOG_S") is None \
+            or watchdog.default_deadline_s() >= 0
+
+
+# --------------------------------------------------------------- quarantine
+
+
+class TestQuarantineBisection:
+    def run_isolate(self, n, poison_ids, settings=None):
+        preps = [make_prep(f"m/{i}") for i in range(n)]
+        dispatched = []
+
+        def dispatch(sub):
+            dispatched.append(len(sub))
+            if any(p.chunk.id in poison_ids for p in sub):
+                raise RuntimeError("poisoned sub-batch")
+            return [(Failure.SUCCESS, fake_result(p.chunk.id))
+                    for p in sub]
+
+        def serial(prep, s, exc):
+            if prep.chunk.id in poison_ids:
+                return quarantine.quarantine_outcome(
+                    prep, s or ConsensusSettings(), exc)
+            return (Failure.SUCCESS, fake_result(prep.chunk.id))
+
+        out = quarantine.isolate(
+            preps, dispatch, settings or ConsensusSettings(),
+            RuntimeError("batch failed"), serial_fn=serial)
+        return out, dispatched
+
+    def test_single_poison_isolated(self):
+        out, dispatched = self.run_isolate(8, {"m/5"})
+        assert [o[0] for o in out] == [Failure.SUCCESS] * 5 + \
+            [Failure.OTHER] + [Failure.SUCCESS] * 2
+        assert all(o[1].id == f"m/{i}" for i, o in enumerate(out)
+                   if o[1] is not None)
+        # log2 isolation: far fewer sub-dispatches than the serial O(n)
+        assert len(dispatched) <= 2 * 3  # 2 halves per level, 3 levels
+
+    def test_multiple_poisons(self):
+        out, _ = self.run_isolate(8, {"m/0", "m/7"})
+        statuses = [o[0] for o in out]
+        assert statuses[0] == statuses[7] == Failure.OTHER
+        assert statuses[1:7] == [Failure.SUCCESS] * 6
+
+    def test_all_poison(self):
+        out, _ = self.run_isolate(4, {f"m/{i}" for i in range(4)})
+        assert all(o == (Failure.OTHER, None) for o in out)
+
+    def test_degrade_emits_draft(self):
+        out, _ = self.run_isolate(
+            4, {"m/2"}, ConsensusSettings(degrade_quarantined=True))
+        failure, result = out[2]
+        assert failure == Failure.SUCCESS
+        assert result.draft_only and result.id == "m/2"
+
+    def test_quarantine_metrics(self):
+        scope = default_registry().scope()
+        self.run_isolate(8, {"m/3"})
+        assert scope.counter_value("ccs_quarantined_zmws_total") == 1
+        self.run_isolate(4, {"m/1"},
+                         ConsensusSettings(degrade_quarantined=True))
+        assert scope.counter_value("ccs_degraded_zmws_total") == 1
+
+
+class TestSerialRescue:
+    def test_persistent_hang_quarantines_not_stalls(self):
+        """A ZMW whose polish hangs EVERY time (not just once) must end
+        quarantined: the serial rescue runs under the same ambient
+        watchdog deadline as the batch dispatch, so the run's last
+        re-polish cannot stall forever."""
+        prep = make_prep("m/0")
+        # low SNR: the abandoned (hung) thread's eventual process_chunk
+        # exits instantly at the SNR gate instead of polishing
+        prep.chunk.snr = np.full(4, 1.0)
+        watchdog.configure(0.2)
+        try:
+            with faults.active("polish.dispatch:delay=5~m/0"):
+                t0 = time.monotonic()
+                failure, result = quarantine.serial_rescue(
+                    prep, ConsensusSettings(), RuntimeError("batch"))
+                assert time.monotonic() - t0 < 2.0  # did not wait out 5 s
+        finally:
+            watchdog.configure(None)
+        assert failure == Failure.OTHER and result is None
+
+
+class TestDegradeToDraft:
+    def test_draft_consensus_shape(self):
+        prep = make_prep("m/9", tpl_len=16, n_reads=3)
+        failure, result = quarantine.degrade_to_draft(
+            prep, ConsensusSettings())
+        assert failure == Failure.SUCCESS
+        assert result.draft_only
+        assert len(result.sequence) == 16
+        assert (np.asarray(result.qvs) == quarantine.DRAFT_QV_CAP).all()
+        assert result.num_passes == 3
+        assert 0.89 < result.predicted_accuracy < 0.91
+        assert np.isnan(result.global_zscore)
+
+
+# --------------------------------------------------------------- checkpoint
+
+
+class TestCheckpoint:
+    def test_result_round_trip(self):
+        r = fake_result("m/1", "ACGTA")
+        back = checkpoint.result_from_json(
+            json.loads(json.dumps(checkpoint.result_to_json(r))))
+        assert back.id == r.id and back.sequence == r.sequence
+        assert back.qualities == r.qualities
+        np.testing.assert_array_equal(back.qvs, r.qvs)
+        np.testing.assert_array_equal(back.status_counts, r.status_counts)
+        # NaN z-scores survive
+        assert np.isnan(back.zscores[1]) and back.zscores[0] == 0.5
+        assert back.draft_only == r.draft_only
+
+    def make_tally(self, ids):
+        from pbccs_tpu.pipeline import ResultTally
+
+        tally = ResultTally()
+        for zid in ids:
+            tally.tally(Failure.SUCCESS)
+            tally.results.append(fake_result(zid))
+        tally.tally(Failure.POOR_SNR)
+        return tally
+
+    def test_journal_round_trip(self, tmp_path):
+        path = str(tmp_path / "j.ckpt")
+        fp = {"version": 1, "inputs": [["a", 10]], "chunk_size": 2}
+        j = checkpoint.CheckpointJournal(path)
+        j.start(fp, resume=False)
+        j.record_chunk(0, self.make_tally(["m/0", "m/1"]))
+        j.record_chunk(1, self.make_tally(["m/2"]))
+        j.close()
+
+        restored = checkpoint.CheckpointJournal(path).load(fp)
+        assert sorted(restored) == [0, 1]
+        assert [r.id for r in restored[0].results] == ["m/0", "m/1"]
+        assert restored[1].counts[Failure.SUCCESS] == 1
+        assert restored[1].counts[Failure.POOR_SNR] == 1
+
+    def test_fingerprint_mismatch_refuses(self, tmp_path):
+        path = str(tmp_path / "j.ckpt")
+        j = checkpoint.CheckpointJournal(path)
+        j.start({"chunk_size": 2}, resume=False)
+        j.record_chunk(0, self.make_tally(["m/0"]))
+        j.close()
+        assert checkpoint.CheckpointJournal(path).load(
+            {"chunk_size": 4}) == {}
+
+    def test_torn_and_corrupt_records_dropped(self, tmp_path):
+        path = str(tmp_path / "j.ckpt")
+        fp = {"chunk_size": 2}
+        j = checkpoint.CheckpointJournal(path)
+        j.start(fp, resume=False)
+        j.record_chunk(0, self.make_tally(["m/0"]))
+        j.record_chunk(1, self.make_tally(["m/1"]))
+        j.close()
+        # tear the LAST record mid-line (kill -9 mid-write)
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[: data.rindex(b'{"type": "chunk"') + 40])
+        scope = default_registry().scope()
+        restored = checkpoint.CheckpointJournal(path).load(fp)
+        assert sorted(restored) == [0]
+        assert scope.counter_value("ccs_checkpoint_records_total",
+                                   kind="corrupt") == 1
+
+    def test_corrupt_fault_site(self, tmp_path):
+        path = str(tmp_path / "j.ckpt")
+        fp = {"chunk_size": 2}
+        with faults.active("checkpoint.record:corrupt@2"):
+            j = checkpoint.CheckpointJournal(path)
+            j.start(fp, resume=False)              # record 1: header
+            j.record_chunk(0, self.make_tally(["m/0"]))  # record 2: corrupt
+            j.record_chunk(1, self.make_tally(["m/1"]))
+            j.close()
+        restored = checkpoint.CheckpointJournal(path).load(fp)
+        assert sorted(restored) == [1]  # chunk 0 dropped, recomputable
+
+    def test_fingerprint_tracks_same_size_content_change(self, tmp_path):
+        """A regenerated same-size input must refuse the resume (mtime
+        is part of the fingerprint): a refused resume only recomputes,
+        a wrong splice silently mixes two datasets."""
+        f = tmp_path / "in.fasta"
+        f.write_text(">a\nACGT\n")
+        fp1 = checkpoint.run_fingerprint([str(f)], 2, ConsensusSettings())
+        os.utime(f, ns=(1, 1))  # same path + size, different mtime
+        fp2 = checkpoint.run_fingerprint([str(f)], 2, ConsensusSettings())
+        assert fp1 != fp2
+
+    def test_resume_appends_and_last_record_wins(self, tmp_path):
+        path = str(tmp_path / "j.ckpt")
+        fp = {"chunk_size": 2}
+        j = checkpoint.CheckpointJournal(path)
+        j.start(fp, resume=False)
+        j.record_chunk(0, self.make_tally(["m/0"]))
+        j.close()
+        j2 = checkpoint.CheckpointJournal(path)
+        assert sorted(j2.load(fp)) == [0]
+        j2.start(fp, resume=True)
+        j2.record_chunk(0, self.make_tally(["m/0x"]))  # re-journal
+        j2.record_chunk(1, self.make_tally(["m/1"]))
+        j2.close()
+        restored = checkpoint.CheckpointJournal(path).load(fp)
+        assert sorted(restored) == [0, 1]
+        assert [r.id for r in restored[0].results] == ["m/0x"]
+
+
+# ------------------------------------------- serve: retry + watchdog wiring
+
+
+def stub_prep(chunk, settings):
+    return None, PreparedZmw(chunk, np.zeros(64, np.int8), [],
+                             len(chunk.reads), 0, 0.0)
+
+
+def stub_polish(preps, settings):
+    return [(Failure.SUCCESS, fake_result(p.chunk.id)) for p in preps]
+
+
+class TestServeResilience:
+    def serve_stack(self, polish=stub_polish, **cfg):
+        from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+        from pbccs_tpu.serve.server import CcsServer
+
+        eng = CcsEngine(config=ServeConfig(**cfg), prep_fn=stub_prep,
+                        polish_fn=polish).start()
+        srv = CcsServer(eng, port=0).start()
+        return eng, srv
+
+    def test_submit_with_retry_rides_out_overloaded(self):
+        """Satellite contract: against a max_pending=1 engine, every
+        submit_with_retry eventually succeeds -- the overloaded
+        rejections are absorbed by the backoff policy."""
+        from pbccs_tpu.serve.client import CcsClient
+
+        def slow_polish(preps, settings):
+            time.sleep(0.15)
+            return stub_polish(preps, settings)
+
+        eng, srv = self.serve_stack(polish=slow_polish, max_batch=1,
+                                    max_wait_ms=10.0, max_pending=1)
+        scope = default_registry().scope()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                results = {}
+                errs = []
+
+                def one(i):
+                    try:
+                        msg = cli.submit_with_retry(
+                            {"id": f"m/{i}",
+                             "reads": [{"seq": "ACGTACGT"}] * 4},
+                            policy=retry.RetryPolicy(
+                                max_attempts=40, base_delay_s=0.05,
+                                max_delay_s=0.2, deadline_s=30.0))
+                        results[i] = msg["status"]
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+
+                threads = [threading.Thread(target=one, args=(i,))
+                           for i in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60.0)
+                assert not errs, errs
+                assert results == {i: "Success" for i in range(4)}
+                # max_pending=1 forces real rejections along the way
+                assert scope.counter_value("ccs_retries_total",
+                                           site="client.submit") >= 1
+        finally:
+            srv.shutdown()
+            eng.close()
+
+    def test_engine_watchdog_fails_batch_keeps_serving(self):
+        from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+
+        hang = threading.Event()
+
+        def hung_once(preps, settings):
+            if not hang.is_set():
+                hang.set()
+                time.sleep(5.0)
+            return stub_polish(preps, settings)
+
+        cfg = ServeConfig(max_batch=1, max_wait_ms=60_000.0,
+                          polish_timeout_ms=200.0)
+        with CcsEngine(config=cfg, prep_fn=stub_prep,
+                       polish_fn=hung_once) as eng:
+            bad = eng.submit(make_chunk("m/hang"))
+            assert bad.wait(10.0)
+            assert bad.error is not None and "watchdog" in bad.error
+            ok = eng.submit(make_chunk("m/2"))
+            assert ok.wait(10.0)
+            assert ok.failure == Failure.SUCCESS
+            assert eng.status()["errors"] == 1
+
+
+# ------------------------------------- pipeline: batch-fallback parity (e2e)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("on_error", ["bisect", "serial"])
+def test_poisoned_batch_survivor_parity(rng, on_error):
+    """A poisoned batch yields byte-identical results for all surviving
+    ZMWs vs an unpoisoned run -- for the bisection path AND the legacy
+    serial path (the satellite contract; chaos_smoke re-checks this in
+    tier-1 CI)."""
+    from pbccs_tpu.pipeline import process_chunks
+    from pbccs_tpu.simulate import simulate_zmw
+
+    chunks = []
+    for i in range(5):
+        _, reads, _, snr = simulate_zmw(rng, 60, 4)
+        chunks.append(Chunk(
+            f"par/{i}",
+            [Subread(f"par/{i}/{k}", r) for k, r in enumerate(reads)],
+            snr))
+    base = process_chunks(list(chunks))
+    base_out = {r.id: (r.sequence, r.qualities) for r in base.results}
+
+    with faults.active("polish.dispatch:error~par/1"):
+        pois = process_chunks(list(chunks), on_error=on_error)
+    pois_out = {r.id: (r.sequence, r.qualities) for r in pois.results}
+    assert pois_out == {k: v for k, v in base_out.items() if k != "par/1"}
+    assert pois.counts[Failure.OTHER] == 1
+    assert pois.total == base.total

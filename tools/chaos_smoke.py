@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Chaos smoke for the tier-1 gate: one fault per class on a simulated
+dataset, asserting the resilience contract end to end.
+
+Fault classes (pbccs_tpu/resilience/):
+
+  poison    a ZMW whose polish always raises -> quarantine bisection
+            isolates it; SURVIVING ZMWs are byte-identical to the
+            fault-free run; quarantine metrics move
+  degrade   the same poison with --degradeQuarantined semantics -> the
+            poison ZMW emits a draft-only consensus (capped QVs)
+  transient a one-shot retryable device error -> RetryPolicy absorbs
+            it; ALL outputs identical to fault-free
+  hang      a dispatch that sleeps past the watchdog deadline ->
+            structured WatchdogTimeout, bisection recovers every ZMW
+  serial    the legacy whole-batch serial fallback path: same
+            surviving-output parity as bisection
+  serve     a live engine fed the poison ZMW keeps serving; surviving
+            replies match the offline run
+
+Runs on CPU in-process (compiled programs are shared across checks), so
+it is cheap enough for CI: tools/tier1.sh runs it after obs_smoke.
+
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # runnable as tools/chaos_smoke.py from the repo root
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.pipeline import (
+    Chunk,
+    ConsensusSettings,
+    Failure,
+    Subread,
+    process_chunks,
+)
+from pbccs_tpu.resilience import faults, watchdog
+from pbccs_tpu.runtime.logging import Logger, LogLevel
+from pbccs_tpu.simulate import simulate_zmw
+
+N_ZMWS = 6
+POISON = "smoke/2"
+
+
+def make_workload() -> list[Chunk]:
+    rng = np.random.default_rng(20260803)
+    chunks = []
+    for i in range(N_ZMWS):
+        _, reads, _, snr = simulate_zmw(rng, 60, 5)
+        chunks.append(Chunk(
+            f"smoke/{i}",
+            [Subread(f"smoke/{i}/{k}", r) for k, r in enumerate(reads)],
+            snr))
+    return chunks
+
+
+def outputs(tally) -> dict[str, tuple[str, str]]:
+    return {r.id: (r.sequence, r.qualities) for r in tally.results}
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}" +
+          (f"  ({detail})" if detail else ""))
+    if not ok:
+        raise SystemExit(f"chaos smoke failed: {name} {detail}")
+
+
+def main() -> int:
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    Logger.default(Logger(level=LogLevel.ERROR))
+    reg = default_registry()
+    chunks = make_workload()
+
+    print("== baseline (fault-free) ==")
+    base = process_chunks(list(chunks))
+    base_out = outputs(base)
+    check("baseline yields successes", base.counts[Failure.SUCCESS] >= 4,
+          f"{base.counts[Failure.SUCCESS]}/{N_ZMWS}")
+    survivors = {k: v for k, v in base_out.items() if k != POISON}
+
+    print("== poison ZMW -> quarantine bisection ==")
+    scope = reg.scope()
+    with faults.active(f"polish.dispatch:error~{POISON}"):
+        pois = process_chunks(list(chunks))
+    check("run completed", pois.total == base.total)
+    check("poison ZMW quarantined as Other",
+          pois.counts[Failure.OTHER] == 1)
+    check("surviving outputs byte-identical", outputs(pois) == survivors)
+    check("ccs_quarantined_zmws_total moved",
+          scope.counter_value("ccs_quarantined_zmws_total") == 1)
+    check("ccs_faults_injected_total moved",
+          scope.counter_value("ccs_faults_injected_total",
+                              site="polish.dispatch", kind="error") > 0)
+
+    print("== poison ZMW -> draft-only degradation ==")
+    scope = reg.scope()
+    with faults.active(f"polish.dispatch:error~{POISON}"):
+        deg = process_chunks(list(chunks),
+                             ConsensusSettings(degrade_quarantined=True))
+    drafts = [r for r in deg.results if r.draft_only]
+    check("poison ZMW emitted as draft-only",
+          [r.id for r in drafts] == [POISON])
+    check("draft QVs capped", all(
+        q <= 10 for r in drafts for q in r.qvs))
+    check("non-degraded outputs byte-identical",
+          {k: v for k, v in outputs(deg).items() if k != POISON}
+          == survivors)
+    check("ccs_degraded_zmws_total moved",
+          scope.counter_value("ccs_degraded_zmws_total") == 1)
+
+    print("== transient device error -> retry ==")
+    scope = reg.scope()
+    with faults.active("polish.dispatch:error=transient@1*1"):
+        tr = process_chunks(list(chunks))
+    check("all outputs identical after retry", outputs(tr) == base_out)
+    check("ccs_retries_total moved",
+          scope.counter_value("ccs_retries_total",
+                              site="polish.dispatch") >= 1)
+
+    print("== hung dispatch -> watchdog + bisection recovery ==")
+    scope = reg.scope()
+    # size the deadline as an operator would: well above a legitimate
+    # re-dispatch (seconds on CPU), well below the injected hang.  The
+    # hang outlives the process so its abandoned thread is still inside
+    # time.sleep -- never inside XLA -- at interpreter teardown.
+    watchdog.configure(20.0)
+    try:
+        with faults.active("polish.dispatch:delay=3600@1*1"):
+            hung = process_chunks(list(chunks))
+    finally:
+        watchdog.configure(None)
+    check("all outputs identical after watchdog recovery",
+          outputs(hung) == base_out)
+    check("ccs_watchdog_timeouts_total moved",
+          scope.counter_value("ccs_watchdog_timeouts_total",
+                              site="polish.dispatch") >= 1)
+
+    print("== poison ZMW -> legacy serial fallback ==")
+    with faults.active(f"polish.dispatch:error~{POISON}"):
+        ser = process_chunks(list(chunks), on_error="serial")
+    check("serial path surviving outputs byte-identical",
+          outputs(ser) == survivors)
+    check("serial path quarantined the poison ZMW",
+          ser.counts[Failure.OTHER] == 1)
+
+    print("== live serve engine survives the poison ==")
+    from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+
+    with faults.active(f"polish.dispatch:error~{POISON}"):
+        with CcsEngine(config=ServeConfig(max_batch=N_ZMWS,
+                                          max_wait_ms=60_000.0)) as eng:
+            reqs = [eng.submit(c) for c in chunks]
+            for r in reqs:
+                check(f"reply for {r.chunk.id}", r.wait(600.0))
+            served = {r.chunk.id: (r.result.sequence, r.result.qualities)
+                      for r in reqs if r.failure == Failure.SUCCESS}
+            check("served survivors match offline", served == survivors)
+            check("engine still answers status",
+                  eng.status()["engine"] == "ccs-serve")
+
+    print("chaos smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

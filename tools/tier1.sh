@@ -12,6 +12,9 @@ python -m compileall -q pbccs_tpu tools || exit 1
 echo "== observability smoke (trace schema) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
+echo "== chaos smoke (fault injection / quarantine / watchdog) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
