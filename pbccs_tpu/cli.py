@@ -21,6 +21,7 @@ import numpy as np
 
 from pbccs_tpu import __version__
 from pbccs_tpu.io.bam import (
+    BamDecodeError,
     BamHeader,
     BamReader,
     BamRecord,
@@ -164,6 +165,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "re-dispatches; serial re-runs the whole batch "
                         "per-ZMW (legacy). Default = %(default)s")
     add_resilience_args(p)
+    p.add_argument("--decodePolicy", choices=("strict", "lenient", "salvage"),
+                   default="strict",
+                   help="BAM corruption handling: strict aborts on the "
+                        "first corrupt byte (reference behavior); lenient "
+                        "skips bad records and counts them; salvage "
+                        "additionally resyncs past corrupt BGZF blocks so "
+                        "one flipped bit costs <=64 KiB of input, not the "
+                        "cell. Default = %(default)s")
     p.add_argument("--skipChemistryCheck", action="store_true",
                    help="Accept non-P6-C4 read groups (required for FASTA "
                         "input, which carries no chemistry metadata).")
@@ -192,11 +201,11 @@ def _iter_fasta_chunks(path: str, log: Logger):
         yield current, None
 
 
-def _iter_bam_chunks(path: str, log: Logger):
+def _iter_bam_chunks(path: str, log: Logger, policy: str = "strict"):
     """Group BAM subread records into per-ZMW chunks.
 
     Yields (chunk, read_group) so the caller can apply the chemistry gate."""
-    reader = BamReader(path)
+    reader = BamReader(path, policy=policy)
     rgs = {rg.id: rg for rg in reader.header.read_groups}
     current: Chunk | None = None
     current_rg: ReadGroupInfo | None = None
@@ -215,15 +224,45 @@ def _iter_bam_chunks(path: str, log: Logger):
         if current is None or current.id != zid:
             if current is not None:
                 yield current, current_rg
-            snr = np.asarray(rec.tags.get("sn", [8.0] * 4), np.float64)
+            try:
+                snr = np.asarray(rec.tags.get("sn", [8.0] * 4), np.float64)
+            except (TypeError, ValueError):
+                # validate_chunk downstream rejects the bad shape; here
+                # only the crash matters (a string `sn` must not abort
+                # a lenient run)
+                snr = np.full(4, np.nan)
             current = Chunk(zid, [], snr)
             rg_id = rec.tags.get("RG", "")
             current_rg = rgs.get(rg_id)
-        flags = int(rec.tags.get("cx", 3))
-        accuracy = float(rec.tags.get("rq", 0.8))
+        try:
+            flags = int(rec.tags.get("cx", 3))
+            accuracy = float(rec.tags.get("rq", 0.8))
+        except (TypeError, ValueError) as e:
+            # structurally valid record, semantically garbage tag values
+            # (e.g. cx as a string): degrade the record, never the run
+            if policy == "strict":
+                raise BamDecodeError(
+                    "bad_tag_value",
+                    f"{rec.name}: cx/rq tag not numeric: {e}") from None
+            # count through reader.stats so the end-of-file rejection
+            # summary below includes these skips too
+            reader.stats.count("bad_tag_value")
+            log.warn(f"skipping read {rec.name}: cx/rq tag not numeric "
+                     "[reason=bad_tag_value]")
+            continue
         current.reads.append(Subread(rec.name, encode_bases(rec.seq),
                                      flags=flags, read_accuracy=accuracy))
     reader.close()
+    stats = reader.stats
+    if stats.total_invalid or stats.bytes_lost:
+        by_reason = ", ".join(f"{k}={v}" for k, v
+                              in sorted(stats.invalid_records.items()))
+        log.warn(f"{path}: decode policy '{policy}' rejected "
+                 f"{stats.total_invalid} record(s)/block(s) [{by_reason}], "
+                 f"salvaged {stats.salvaged_blocks} block resync(s), "
+                 f"{stats.bytes_lost} byte(s) lost"
+                 + (" (input truncated mid-stream; pair with --resume "
+                    "after re-fetching)" if stats.truncated else ""))
     if current is not None:
         yield current, current_rg
 
@@ -231,15 +270,26 @@ def _iter_bam_chunks(path: str, log: Logger):
 def _chunks_from_files(files, whitelist: Whitelist, args, log,
                        tally: ResultTally):
     """Apply CLI-level gates and yield batches of chunks."""
+    from pbccs_tpu.io.validate import ChunkValidationError, validate_chunk
+
     batch: list[Chunk] = []
     for path in files:
         is_fasta = any(path.endswith(e) for e in FASTA_EXTS)
         it = (_iter_fasta_chunks(path, log) if is_fasta
-              else _iter_bam_chunks(path, log))
+              else _iter_bam_chunks(path, log, policy=args.decodePolicy))
         for chunk, rg in it:
             movie, hole_s = chunk.id.split("/")[:2]
             hole = int(hole_s)
             if not whitelist.contains(movie, hole):
+                continue
+            try:
+                # the shared input contract (io.validate): the serve
+                # front door rejects the same garbage with the same
+                # reasons at `submit` (protocol.chunk_from_wire)
+                validate_chunk(chunk)
+            except ChunkValidationError as e:
+                log.warn(f"rejecting ZMW {chunk.id}: {e} "
+                         f"[reason={e.reason}]")
                 continue
             if not args.skipChemistryCheck:
                 if rg is None or not verify_chemistry(rg):

@@ -120,6 +120,18 @@ class ServeConfig:
     # requests and the engine keeps serving.  0 disables.  Size it well
     # above a worst-case polish incl. quarantine bisection re-dispatches.
     polish_timeout_ms: float = 0.0
+    # ---- wire-protocol armor (enforced by server._Session) ----
+    # longest accepted NDJSON frame; an oversized frame gets a
+    # `bad_request` reply and the session closes (the line buffer is the
+    # only per-session allocation an untrusted peer controls)
+    max_line_bytes: int = 8 << 20
+    # submits one session may have in flight before further submits are
+    # rejected `overloaded` WITHOUT touching the engine (one hostile
+    # session cannot monopolize the shared admission pool)
+    max_inflight_per_session: int = 64
+    # reap sessions with nothing in flight that send no byte for this
+    # long (slow-loris defense); 0 disables
+    idle_timeout_s: float = 600.0
 
 
 @dataclasses.dataclass
@@ -216,22 +228,43 @@ class CcsEngine:
             f"max_pending={self.config.max_pending}")
         return self
 
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True,
+              deadline_s: float | None = None) -> bool:
         """Stop admission; with drain (default) finish everything already
-        admitted, else fail pending requests with a `closed` error."""
+        admitted, else fail pending requests with a `closed` error.
+
+        ``deadline_s`` bounds the drain wait: past it the engine falls
+        back to fast abort (remaining requests fail with a structured
+        `closed` error) instead of hanging shutdown on a stuck device.
+        Returns True when every admitted request completed normally."""
         with self._lock:
             if self._closed:
-                return
+                return True
             self._closed = True
             self._abort = not drain
+            pending0 = self._pending
+        # drain=False with requests in the system WILL fail them with a
+        # `closed` error -- that is not a clean drain
+        drained = drain or pending0 == 0
         if drain:
             # wait for admitted requests to complete (they flow through
             # prep -> batcher -> polish on their own; the flush loop ships
             # not-yet-due buckets immediately once it sees _closed)
+            give_up_at = (time.monotonic() + deadline_s
+                          if deadline_s else None)
             while True:
                 with self._lock:
                     if self._pending == 0:
                         break
+                    pending = self._pending
+                if give_up_at is not None and time.monotonic() > give_up_at:
+                    with self._lock:
+                        self._abort = True
+                    drained = False
+                    self._log.warn(
+                        f"drain deadline ({deadline_s}s) exceeded with "
+                        f"{pending} request(s) pending: aborting")
+                    break
                 with self._wake:
                     self._wake.notify_all()
                 time.sleep(0.01)
@@ -252,7 +285,9 @@ class CcsEngine:
             self._polish_queue.put(None)
         for t in self._threads:
             t.join(timeout=10.0)
-        if not drain:
+        with self._lock:
+            aborted = self._abort
+        if aborted:
             # fail whatever is still parked anywhere
             leftovers = [i.payload[0] for b in self._batcher.drain()
                          for i in b.items]
@@ -267,6 +302,7 @@ class CcsEngine:
                 self._complete_error(req, "engine closed")
         self.trace_stop()  # never leak a live capture past the engine
         self._log.info("ccs engine down")
+        return drained
 
     def __enter__(self) -> "CcsEngine":
         return self.start()

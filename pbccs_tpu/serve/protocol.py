@@ -31,11 +31,24 @@ Server replies:
            "already_running" | "stopped" | "not_running",
            "trace": {..Chrome-trace JSON..}}  # on state "stopped" only
   pong    {"type": "pong", "id": ...}
+  closed  {"type": "closed", "reason": "draining" | "idle_timeout"}
+          -- unsolicited: the server is about to close this session
+          (graceful drain, or the idle-session reaper fired)
 
 Error codes: bad_request (unparseable/invalid message -- the session
-stays open), overloaded (admission queue full: backpressure, retry
-later), closed (engine shutting down), internal (the request raised
-inside the engine; the SERVER stays up, only this request fails).
+stays open unless the frame itself broke framing, e.g. oversized),
+overloaded (admission queue full OR the per-session in-flight cap:
+backpressure, retry later), closed (engine shutting down), internal
+(the request raised inside the engine; the SERVER stays up, only this
+request fails).
+
+Protocol armor (ServeConfig limits, enforced by server._Session): frames
+longer than max_line_bytes get `bad_request` and the session closes;
+sessions idle past idle_timeout_s with nothing in flight are reaped with
+a `closed` notice; submits past max_inflight_per_session are rejected
+`overloaded` without touching the engine.  The `zmw` payload passes the
+same io.validate.validate_chunk contract the offline CLI reader applies,
+so both front doors reject garbage identically.
 
 The ZMW wire layout mirrors pipeline.Chunk:
   {"id": "movie/hole", "snr": [A, C, G, T],
@@ -69,6 +82,7 @@ TYPE_STATUS = "status"
 TYPE_METRICS = "metrics"
 TYPE_TRACE = "trace"
 TYPE_PONG = "pong"
+TYPE_CLOSED = "closed"
 
 # the Prometheus text exposition format version the metrics verb speaks
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
@@ -130,7 +144,8 @@ def chunk_from_wire(zmw: Any) -> Chunk:
         raise ProtocolError("zmw.id must be a non-empty string")
     snr = zmw.get("snr", [8.0] * 4)
     if (not isinstance(snr, list) or len(snr) != 4
-            or not all(isinstance(s, (int, float)) for s in snr)):
+            or not all(isinstance(s, (int, float))
+                       and not isinstance(s, bool) for s in snr)):
         raise ProtocolError("zmw.snr must be 4 numbers (ACGT)")
     reads = zmw.get("reads")
     if not isinstance(reads, list) or not reads:
@@ -145,6 +160,10 @@ def chunk_from_wire(zmw: Any) -> Chunk:
             raise ProtocolError(
                 f"zmw.reads[{i}].seq must be ASCII base characters"
             ) from None
+        if isinstance(r.get("flags"), bool) \
+                or isinstance(r.get("accuracy"), bool):
+            raise ProtocolError(
+                f"zmw.reads[{i}] flags/accuracy must be numeric")
         try:
             flags = int(r.get("flags", 3))
             accuracy = float(r.get("accuracy", 0.8))
@@ -153,7 +172,17 @@ def chunk_from_wire(zmw: Any) -> Chunk:
                 f"zmw.reads[{i}] flags/accuracy must be numeric") from None
         subreads.append(Subread(id=str(r.get("id", f"{zid}/{i}")), seq=seq,
                                 flags=flags, read_accuracy=accuracy))
-    return Chunk(zid, subreads, np.asarray(snr, np.float64))
+    chunk = Chunk(zid, subreads, np.asarray(snr, np.float64))
+    from pbccs_tpu.io.validate import ChunkValidationError, validate_chunk
+
+    try:
+        # the same contract the offline CLI reader enforces (io.validate):
+        # counts ccs_input_invalid_records_total{reason} and gives the
+        # client the structured reason
+        validate_chunk(chunk)
+    except ChunkValidationError as e:
+        raise ProtocolError(f"zmw rejected ({e.reason}): {e}") from None
+    return chunk
 
 
 # --------------------------------------------------------------- result wire

@@ -12,15 +12,26 @@ reply and the session lives on; an engine-side raise gets `internal` and
 the server lives on; a client that disconnects mid-stream only kills its
 own session (its in-flight requests complete engine-side and their
 replies are dropped on the closed socket).
+
+Wire-protocol armor (ServeConfig knobs): the session reader enforces a
+max frame length (oversized -> `bad_request` + close), an idle read
+timeout (slow-loris sessions with nothing in flight are reaped with a
+`closed` notice), and a per-session in-flight cap (excess submits are
+rejected `overloaded` without touching the engine).  Every abnormal
+session end is counted under ccs_serve_session_aborts_total{cause} and
+logged at debug with peer + direction, so a fleet saturating the armor
+is visible before it is a problem.
 """
 
 from __future__ import annotations
 
 import argparse
+import signal
 import socket
 import sys
 import threading
 
+from pbccs_tpu.obs.metrics import default_registry
 from pbccs_tpu.runtime.logging import Logger, LogLevel
 from pbccs_tpu.serve import protocol
 from pbccs_tpu.serve.engine import (
@@ -31,16 +42,36 @@ from pbccs_tpu.serve.engine import (
     ServeConfig,
 )
 
+_reg = default_registry()
+_m_cap_rejects = _reg.counter(
+    "ccs_serve_inflight_cap_rejects_total",
+    "Submits rejected by the per-session in-flight cap")
+
+
+def _count_abort(cause: str) -> None:
+    _reg.counter("ccs_serve_session_aborts_total",
+                 "Sessions ended abnormally, by cause",
+                 cause=cause).inc()
+
 
 class _Session:
     """One connected client: a reader loop + a locked writer."""
+
+    _RECV = 1 << 16
 
     def __init__(self, server: "CcsServer", conn: socket.socket, peer):
         self.server = server
         self.conn = conn
         self.peer = peer
         self.alive = True
+        self.closing = False      # server-initiated close (drain/shutdown)
         self._wlock = threading.Lock()
+        self._ilock = threading.Lock()
+        self._inflight = 0
+
+    def inflight(self) -> int:
+        with self._ilock:
+            return self._inflight
 
     def send(self, msg: dict) -> None:
         """Best-effort reply: a dead socket marks the session closed but
@@ -50,27 +81,57 @@ class _Session:
         try:
             with self._wlock:
                 self.conn.sendall(data)
-        except OSError:
+        except OSError as e:
+            if self.alive and not self.closing:
+                self.server.log.debug(
+                    f"session {self.peer}: send failed ({e!r}); "
+                    "marking session dead")
+                _count_abort("send_failed")
             self.alive = False
 
     # ------------------------------------------------------------- verbs
 
     def _on_submit(self, msg: dict) -> None:
         rid = msg.get("id")
+        cap = self.server.engine.config.max_inflight_per_session
+        with self._ilock:
+            if self._inflight >= cap:
+                capped = True
+            else:
+                capped = False
+                self._inflight += 1
+        if capped:
+            # rejected BEFORE parsing/admission: one hostile session can
+            # neither monopolize the engine pool nor make it parse
+            # unbounded payloads it will reject anyway
+            _m_cap_rejects.inc()
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_OVERLOADED,
+                f"per-session in-flight cap ({cap}) reached; "
+                "wait for results before submitting more"))
+            return
+
+        def release() -> None:
+            with self._ilock:
+                self._inflight -= 1
+
         try:
             chunk = protocol.chunk_from_wire(msg.get("zmw"))
         except protocol.ProtocolError as e:
+            release()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, str(e)))
             return
         deadline_ms = msg.get("deadline_ms")
         if deadline_ms is not None and not isinstance(deadline_ms,
                                                       (int, float)):
+            release()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, "deadline_ms must be a number"))
             return
 
         def on_done(req: Request) -> None:
+            release()
             if req.error is not None:
                 self.send(protocol.error_to_wire(
                     rid, protocol.ERR_INTERNAL, req.error))
@@ -83,9 +144,11 @@ class _Session:
             self.server.engine.submit(chunk, deadline_ms=deadline_ms,
                                       callback=on_done)
         except EngineOverloaded as e:
+            release()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_OVERLOADED, str(e)))
         except EngineClosed as e:
+            release()
             self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
                                              str(e)))
 
@@ -124,40 +187,81 @@ class _Session:
 
     # ------------------------------------------------------------- reader
 
+    def _dispatch(self, line: bytes) -> None:
+        try:
+            msg = protocol.decode_line(line)
+        except protocol.ProtocolError as e:
+            self.send(protocol.error_to_wire(
+                None, protocol.ERR_BAD_REQUEST, str(e)))
+            return
+        verb = msg.get("verb")
+        if verb == protocol.VERB_SUBMIT:
+            self._on_submit(msg)
+        elif verb == protocol.VERB_STATUS:
+            self._on_status(msg)
+        elif verb == protocol.VERB_METRICS:
+            self._on_metrics(msg)
+        elif verb == protocol.VERB_TRACE:
+            self._on_trace(msg)
+        elif verb == protocol.VERB_PING:
+            self.send({"type": protocol.TYPE_PONG, "id": msg.get("id")})
+        else:
+            self.send(protocol.error_to_wire(
+                msg.get("id"), protocol.ERR_BAD_REQUEST,
+                f"unknown verb: {verb!r}"))
+
     def run(self) -> None:
         log = self.server.log
+        cfg = self.server.engine.config
         log.debug(f"session open: {self.peer}")
+        cause = None
         try:
-            with self.conn.makefile("rb") as rf:
-                for line in rf:
-                    if not line.strip():
-                        continue
+            self.conn.settimeout(cfg.idle_timeout_s or None)
+            buf = bytearray()
+            while True:
+                nl = buf.find(b"\n")
+                # the current frame's length so far -- complete (up to
+                # the newline) or still accumulating (whole buffer, the
+                # only per-session allocation an untrusted peer controls)
+                if (nl if nl >= 0 else len(buf)) > cfg.max_line_bytes:
+                    self.send(protocol.error_to_wire(
+                        None, protocol.ERR_BAD_REQUEST,
+                        f"frame exceeds max_line_bytes="
+                        f"{cfg.max_line_bytes}; closing session"))
+                    cause = "oversized_frame"
+                    return
+                if nl < 0:
                     try:
-                        msg = protocol.decode_line(line)
-                    except protocol.ProtocolError as e:
-                        self.send(protocol.error_to_wire(
-                            None, protocol.ERR_BAD_REQUEST, str(e)))
-                        continue
-                    verb = msg.get("verb")
-                    if verb == protocol.VERB_SUBMIT:
-                        self._on_submit(msg)
-                    elif verb == protocol.VERB_STATUS:
-                        self._on_status(msg)
-                    elif verb == protocol.VERB_METRICS:
-                        self._on_metrics(msg)
-                    elif verb == protocol.VERB_TRACE:
-                        self._on_trace(msg)
-                    elif verb == protocol.VERB_PING:
-                        self.send({"type": protocol.TYPE_PONG,
-                                   "id": msg.get("id")})
-                    else:
-                        self.send(protocol.error_to_wire(
-                            msg.get("id"), protocol.ERR_BAD_REQUEST,
-                            f"unknown verb: {verb!r}"))
-        except OSError:
-            pass  # peer reset mid-read: same as EOF
+                        data = self.conn.recv(self._RECV)
+                    except socket.timeout:
+                        if self.inflight() > 0:
+                            continue  # quiet but waiting on results
+                        self.send({"type": protocol.TYPE_CLOSED,
+                                   "reason": "idle_timeout"})
+                        cause = "idle_timeout"
+                        return
+                    except OSError as e:
+                        if not self.closing:
+                            log.debug(f"session {self.peer}: recv failed "
+                                      f"({e!r}); treating as peer reset")
+                            cause = "peer_reset"
+                        return
+                    if not data:
+                        if buf.strip():
+                            # peer sent half a frame then FIN
+                            cause = "torn_frame"
+                        return
+                    buf += data
+                    continue
+                line = bytes(buf[:nl])
+                del buf[: nl + 1]
+                if line.strip():
+                    self._dispatch(line)
         finally:
             self.alive = False
+            if cause is not None:
+                _count_abort(cause)
+                log.debug(f"session {self.peer} aborted: {cause}")
             try:
                 self.conn.close()
             except OSError:
@@ -231,17 +335,47 @@ class CcsServer:
         finally:
             self.shutdown()
 
-    def shutdown(self) -> None:
-        if self._shutdown.is_set():
-            return
-        self._shutdown.set()
+    def stop_accepting(self) -> None:
+        """Close the listening socket: existing sessions live on, new
+        connects fail (the graceful-drain first step)."""
         try:
             self._sock.close()
         except OSError:
             pass
+
+    def notify_draining(self) -> None:
+        """Graceful-drain second step: tell every idle session (nothing
+        in flight) the server is going away via a `closed` notice and
+        close it; sessions with in-flight requests stay open so their
+        streamed results can land before shutdown()."""
         with self._slock:
             sessions = list(self._sessions)
         for s in sessions:
+            if s.inflight() > 0:
+                continue
+            s.closing = True
+            s.send({"type": protocol.TYPE_CLOSED, "reason": "draining"})
+            try:
+                # shutdown (not close): the reader thread still holds the
+                # fd in recv, and only shutdown() FINs the peer + wakes
+                # the reader while it does
+                s.conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        self.stop_accepting()
+        with self._slock:
+            sessions = list(self._sessions)
+        for s in sessions:
+            s.closing = True
+            try:
+                s.conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.conn.close()
             except OSError:
@@ -283,6 +417,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadlineMs", type=float,
                    default=defaults.default_deadline_ms,
                    help="Default per-request deadline. Default = %(default)s")
+    # wire-protocol armor + drain (the input-hardening knobs; see
+    # protocol.py "Protocol armor" and docs/DESIGN.md "Input hardening")
+    p.add_argument("--maxLineBytes", type=int,
+                   default=defaults.max_line_bytes,
+                   help="Longest accepted NDJSON frame; oversized frames "
+                        "get bad_request and the session closes. "
+                        "Default = %(default)s")
+    p.add_argument("--maxInflightPerSession", type=int,
+                   default=defaults.max_inflight_per_session,
+                   help="Submits one session may have in flight before "
+                        "rejection as overloaded. Default = %(default)s")
+    p.add_argument("--idleTimeout", type=float,
+                   default=defaults.idle_timeout_s,
+                   help="Reap sessions idle (no bytes, nothing in flight) "
+                        "this many seconds; 0 disables. "
+                        "Default = %(default)s")
+    p.add_argument("--drainTimeout", type=float, default=30.0,
+                   help="On SIGTERM/SIGINT, wait this long for in-flight "
+                        "requests before fast-aborting the rest. "
+                        "Default = %(default)s")
     # consensus + resilience knobs shared (definition and defaults) with
     # the offline CLI; serve maps --polishTimeout to the ENGINE-level
     # watchdog (ServeConfig.polish_timeout_ms) rather than the ambient
@@ -319,12 +473,44 @@ def run_serve(argv: list[str] | None = None) -> int:
         prep_workers=args.prepWorkers,
         default_deadline_ms=args.deadlineMs,
         min_read_score=args.minReadScore,
-        polish_timeout_ms=(args.polishTimeout or 0) * 1e3)
+        polish_timeout_ms=(args.polishTimeout or 0) * 1e3,
+        max_line_bytes=args.maxLineBytes,
+        max_inflight_per_session=args.maxInflightPerSession,
+        idle_timeout_s=args.idleTimeout)
 
     with CcsEngine(settings, config, logger=log) as engine:
         server = CcsServer(engine, args.host, args.port, logger=log)
+        server.start()
         # machine-readable ready line for wrappers (serve_bench polls it)
         print(f"CCS-SERVE-READY {server.host} {server.port}", flush=True)
-        server.serve_forever()
+
+        # graceful drain: a k8s-style TERM (or ^C) stops admission,
+        # finishes what is in flight (bounded by --drainTimeout, falling
+        # back to fast abort), and exits 0 -- never a mid-batch kill
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            # machine-readable line for wrappers (mirrors CCS-SERVE-READY)
+            print(f"CCS-SERVE-DRAINING "
+                  f"signal={signal.Signals(signum).name}", flush=True)
+            stop.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _on_signal)
+            except ValueError:  # not the main thread (embedded serve)
+                pass
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        log.info("ccs serve draining: admission stopped, waiting for "
+                 f"in-flight requests (deadline {args.drainTimeout}s)")
+        server.stop_accepting()
+        server.notify_draining()
+        drained = engine.close(drain=True, deadline_s=args.drainTimeout)
+        server.shutdown()
+        log.info("ccs serve drained cleanly" if drained
+                 else "ccs serve drain deadline hit; aborted remainder")
     log.flush()
     return 0

@@ -21,6 +21,11 @@ skips:
      hung dispatch: the affected requests fail with a structured
      timeout, the engine keeps serving, and a follow-up request
      succeeds.
+  5. INPUT FUZZ -- the randomized long leg of tools/fuzz_inputs.py:
+     --fuzzRounds seeded structured corruptions over the BAM decode
+     classes (bit flips, truncation, length-field lies, tag mutations),
+     asserting the hardening invariant at bench scale (process
+     survives, valid records byte-identical, rejections counted).
 
 Reports JSON (stdout, plus --out FILE).
 
@@ -60,6 +65,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=20260803)
     p.add_argument("--skip-subprocess", action="store_true",
                    help="skip the kill -9 / crash CLI legs (fast mode)")
+    p.add_argument("--fuzzRounds", type=int, default=40,
+                   help="randomized input-fuzz rounds (0 disables)")
     p.add_argument("--out", default=None, help="also write the JSON here")
     return p
 
@@ -272,6 +279,22 @@ def leg_serve_watchdog(chunks, report: dict) -> None:
                   eng.status()["engine"] == "ccs-serve")
 
 
+# ---------------------------------------------------------- 5. input fuzz
+
+def leg_input_fuzz(args, report: dict) -> None:
+    """The randomized long leg of the structured input fuzzer: every
+    decode corruption class re-rolled --fuzzRounds times (fuzz_inputs
+    --smoke is the deterministic tier-1 subset of this)."""
+    print(f"== leg 5: randomized input fuzz ({args.fuzzRounds} rounds) ==")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fuzz_inputs
+
+    rc = fuzz_inputs.main(["--seed", str(args.seed),
+                           "--rounds", str(args.fuzzRounds)])
+    check(report, "input_fuzz_rounds", rc == 0,
+          f"{args.fuzzRounds} rounds, seed {args.seed}")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from pbccs_tpu.runtime.cache import enable_compilation_cache
@@ -291,6 +314,8 @@ def main(argv=None) -> int:
             leg_kill9_resume(args, tmp, fasta, report)
             leg_crash_resume(args, tmp, fasta, report)
         leg_serve_watchdog(chunks, report)
+        if args.fuzzRounds:
+            leg_input_fuzz(args, report)
     except CheckFailed as e:
         report["failed"] = str(e)
         failed = True

@@ -1,0 +1,650 @@
+#!/usr/bin/env python
+"""Structured input fuzzer: hostile bytes at every front door.
+
+Generates a VALID workload (a multi-block subread BAM and an NDJSON
+serve session), applies seeded structured corruptions, and asserts the
+input-hardening invariant:
+
+    the process survives, valid records decode byte-identical to the
+    uncorrupted run, and every rejection moves a {reason}-labeled
+    counter -- corruption degrades a record or a session, never the run.
+
+Corruption classes (each deterministic from (seed, class) alone, so any
+finding reproduces with `--seed S --only CLASS`):
+
+  compressed layer   bam:bitflip (one flipped bit mid-stream),
+                     bam:truncate (cut at a random byte),
+                     bam:torn_final (final block cut short)
+  record layer       bam:blocklen_huge / bam:blocklen_lie (length-field
+                     lies), bam:tagtype (unknown tag type),
+                     bam:nibble (non-ACGT base), bam:bad_snr (inf SNR),
+                     bam:header_magic (clobbered BAM magic)
+  wire protocol      wire:oversized_frame, wire:binary_garbage,
+                     wire:bad_json, wire:bad_zmw, wire:idle_session,
+                     wire:inflight_cap
+  process            drain: kill -TERM a live `ccs serve` -> it reports
+                     CCS-SERVE-DRAINING, drains in flight, exits 0
+
+`--smoke --seed 0` (the tier-1 leg) runs every class once plus a
+consensus-parity check (surviving ZMWs of a corrupted BAM polish
+byte-identical to the clean run).  `--rounds N` (chaos_bench's longer
+leg) re-rolls randomized corruption positions N times over the decode
+classes.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --smoke --seed 0
+    JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --rounds 50 --seed 7
+    JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --seed 0 --only bam:bitflip
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import numpy as np
+
+sys.path.insert(0, ".")  # runnable as tools/fuzz_inputs.py from the repo root
+
+from pbccs_tpu.io import bam as bamio
+from pbccs_tpu.obs.metrics import default_registry
+
+_REG = default_registry()
+
+# mirror chaos_smoke's workload (6 ZMWs, tpl 60, 5 passes) for the
+# consensus leg so its compiled shapes are already cached in tier-1
+CONSENSUS_SEED = 20260803
+
+
+class CheckFailed(AssertionError):
+    pass
+
+
+def check(report: dict, name: str, ok: bool, detail: str = "") -> None:
+    report[name] = bool(ok) if not detail else f"{bool(ok)} ({detail})"
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}"
+          + (f"  ({detail})" if detail else ""))
+    if not ok:
+        raise CheckFailed(name)
+
+
+# ------------------------------------------------------------- BAM workload
+
+class BamWorkload:
+    """A valid multi-block BAM kept in mutable parts: header blob +
+    per-record encoded blobs, so corruption classes can lie about
+    specific fields before compression."""
+
+    def __init__(self, seed: int, n_records: int = 48, seq_len: int = 3500):
+        rng = np.random.default_rng([seed, 0xBA])
+        text = bamio.BamHeader(
+            read_groups=[bamio.ReadGroupInfo("fuzz")]).to_text().encode()
+        self.header_blob = (b"BAM\x01" + struct.pack("<i", len(text)) + text
+                            + struct.pack("<i", 0))
+        self.records: list[bamio.BamRecord] = []
+        self.rec_blobs: list[bytes] = []
+        for i in range(n_records):
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, seq_len))
+            qual = "".join(chr(33 + int(q))
+                           for q in rng.integers(10, 50, seq_len))
+            rec = bamio.BamRecord(
+                name=f"fuzz/{i}/0_{seq_len}", seq=seq, qual=qual,
+                tags={"RG": bamio.make_read_group_id("fuzz", "SUBREAD"),
+                      "zm": i, "cx": 3, "rq": 0.85,
+                      "sn": [7.0, 8.0, 9.0, 10.0]})
+            self.records.append(rec)
+            self.rec_blobs.append(bamio.encode_record(rec))
+
+    def payload(self, rec_blobs: list[bytes] | None = None) -> bytes:
+        return self.header_blob + b"".join(rec_blobs or self.rec_blobs)
+
+    def compress(self, payload: bytes | None = None) -> bytes:
+        buf = io.BytesIO()
+        w = bamio.BgzfWriter(buf)
+        w.write(payload if payload is not None else self.payload())
+        w.close()
+        return buf.getvalue()
+
+    def baseline(self, tmp: str) -> dict[str, tuple]:
+        """Fingerprints of a STRICT decode of the clean bytes (not the
+        in-memory records: float tags round-trip through f32)."""
+        if not hasattr(self, "_baseline"):
+            records, _, _, _ = _decode(self.compress(), "strict", tmp)
+            self._baseline = {r.name: _fingerprint(r) for r in records}
+        return self._baseline
+
+
+def _fingerprint(rec: bamio.BamRecord) -> tuple:
+    return (rec.seq, rec.qual, rec.flag,
+            json.dumps(rec.tags, sort_keys=True, default=str))
+
+
+def _mutate_blob(blob: bytes, sig: bytes, offset: int,
+                 replacement: bytes) -> bytes:
+    """Replace bytes at (index of sig) + offset inside one record blob."""
+    at = blob.index(sig) + offset
+    return blob[:at] + replacement + blob[at + len(replacement):]
+
+
+# Each corruption: fn(workload, rng) -> (corrupt_bytes, detail, hints).
+# hints: lost_names (exactly these records vanish), expect_reasons (at
+# least one of these counters moves), max_lost_salvage (salvage recovery
+# bound; None = suffix loss, no bound), prefix_only (survivors must be a
+# baseline prefix).
+
+def _c_bitflip(w: BamWorkload, rng) -> tuple:
+    data = bytearray(w.compress())
+    # flip inside a middle block's payload: past the first block, clear
+    # of the EOF marker
+    pos = int(rng.integers(70_000, len(data) - 200))
+    data[pos] ^= 1 << int(rng.integers(0, 8))
+    per_block = (64 * 1024 - 512) // len(w.rec_blobs[0]) + 2
+    return bytes(data), f"bit flipped at byte {pos}", dict(
+        expect_reasons={"bgzf_block"}, max_lost_salvage=per_block + 2)
+
+
+def _c_truncate(w: BamWorkload, rng) -> tuple:
+    data = w.compress()
+    pos = int(rng.integers(len(data) // 3, len(data) - 100))
+    return data[:pos], f"truncated at byte {pos}/{len(data)}", dict(
+        expect_reasons={"truncated_block", "truncated_record",
+                        "missing_eof_marker", "bgzf_block"},
+        prefix_only=True)
+
+
+def _c_torn_final(w: BamWorkload, rng) -> tuple:
+    data = w.compress()
+    cut = int(rng.integers(5, 40))
+    return data[:-cut], f"final {cut} bytes torn off", dict(
+        expect_reasons={"truncated_block", "truncated_record",
+                        "missing_eof_marker", "bgzf_block"},
+        prefix_only=True)
+
+
+def _c_blocklen_huge(w: BamWorkload, rng) -> tuple:
+    k = int(rng.integers(1, len(w.rec_blobs) - 1))
+    blobs = list(w.rec_blobs)
+    blobs[k] = struct.pack("<i", 1 << 30) + blobs[k][4:]
+    return w.compress(w.payload(blobs)), \
+        f"record {k} block_size -> 1<<30", dict(
+            expect_reasons={"block_size"}, max_lost_salvage=1,
+            prefix_lenient=True)
+
+
+def _c_blocklen_lie(w: BamWorkload, rng) -> tuple:
+    k = int(rng.integers(1, len(w.rec_blobs) - 1))
+    blobs = list(w.rec_blobs)
+    true_len = struct.unpack_from("<i", blobs[k])[0]
+    blobs[k] = struct.pack("<i", true_len - 40) + blobs[k][4:]
+    return w.compress(w.payload(blobs)), \
+        f"record {k} block_size {true_len} -> {true_len - 40}", dict(
+            expect_reasons={"seq_qual", "overflow", "block_size",
+                            "tag_overflow", "name", "tag_type"},
+            max_lost_salvage=3, prefix_lenient=True)
+
+
+def _c_tagtype(w: BamWorkload, rng) -> tuple:
+    k = int(rng.integers(0, len(w.rec_blobs)))
+    blobs = list(w.rec_blobs)
+    blobs[k] = _mutate_blob(blobs[k], b"zmi", 2, b"q")
+    return w.compress(w.payload(blobs)), \
+        f"record {k} zm tag type i -> q", dict(
+            lost_names={w.records[k].name},
+            expect_reasons={"tag_type"}, max_lost_salvage=1)
+
+
+def _c_nibble(w: BamWorkload, rng) -> tuple:
+    k = int(rng.integers(0, len(w.rec_blobs)))
+    blobs = list(w.rec_blobs)
+    blob = blobs[k]
+    seq_off = 4 + 32 + len(w.records[k].name) + 1
+    blobs[k] = blob[:seq_off] + b"\xff" + blob[seq_off + 1:]  # two N's
+    return w.compress(w.payload(blobs)), \
+        f"record {k} first seq byte -> 0xFF (NN)", dict(
+            lost_names={w.records[k].name},
+            expect_reasons={"non_acgt"}, max_lost_salvage=1)
+
+
+def _c_bad_snr(w: BamWorkload, rng) -> tuple:
+    k = int(rng.integers(0, len(w.rec_blobs)))
+    blobs = list(w.rec_blobs)
+    inf = struct.pack("<f", float("inf"))
+    blobs[k] = _mutate_blob(blobs[k], b"snBf", 8, inf)
+    return w.compress(w.payload(blobs)), \
+        f"record {k} sn[0] -> inf", dict(
+            lost_names={w.records[k].name},
+            expect_reasons={"bad_snr"}, max_lost_salvage=1)
+
+
+def _c_header_magic(w: BamWorkload, rng) -> tuple:
+    payload = b"XAM\x02" + w.payload()[4:]
+    return w.compress(payload), "BAM magic clobbered", dict(
+        expect_reasons={"header"}, max_lost_salvage=2,
+        prefix_lenient=True)
+
+
+BAM_CLASSES = [
+    ("bam:bitflip", _c_bitflip),
+    ("bam:truncate", _c_truncate),
+    ("bam:torn_final", _c_torn_final),
+    ("bam:blocklen_huge", _c_blocklen_huge),
+    ("bam:blocklen_lie", _c_blocklen_lie),
+    ("bam:tagtype", _c_tagtype),
+    ("bam:nibble", _c_nibble),
+    ("bam:bad_snr", _c_bad_snr),
+    ("bam:header_magic", _c_header_magic),
+]
+
+
+def _decode(data: bytes, policy: str, tmp: str):
+    path = os.path.join(tmp, f"case_{policy}.bam")
+    with open(path, "wb") as f:
+        f.write(data)
+    scope = _REG.scope()
+    reader = bamio.BamReader(path, policy=policy)
+    records = list(reader)
+    reader.close()
+    rejected = sum(scope.counters(
+        "ccs_input_invalid_records_total").values())
+    salvaged = scope.counter_value("ccs_input_salvaged_blocks_total")
+    return records, reader.stats, rejected, salvaged
+
+
+def run_bam_case(name: str, corrupt_fn, workload: BamWorkload, seed: int,
+                 tmp: str, report: dict) -> None:
+    # rng derived from (seed, class name) ALONE: any finding reproduces
+    # with `--seed S --only CLASS` (crc32, not hash(): PYTHONHASHSEED
+    # must not change where corruption lands)
+    rng = np.random.default_rng([seed, zlib.crc32(name.encode())])
+    data, detail, hints = corrupt_fn(workload, rng)
+    baseline = workload.baseline(tmp)
+    base_names = [r.name for r in workload.records]
+    print(f"CASE {name} seed={seed} ({detail})")
+    for policy in ("lenient", "salvage"):
+        tag = f"{name}:{policy}"
+        try:
+            records, stats, rejected, salvaged = _decode(data, policy, tmp)
+        except Exception as e:  # noqa: BLE001 -- the invariant under test
+            check(report, f"{tag}:survives", False, repr(e))
+            return
+        check(report, f"{tag}:survives", True)
+        # every yielded record is byte-identical to its baseline twin;
+        # no fabricated records
+        clean = all(r.name in baseline
+                    and _fingerprint(r) == baseline[r.name]
+                    for r in records)
+        check(report, f"{tag}:valid_records_identical", clean,
+              f"{len(records)}/{len(base_names)} decoded")
+        lost = set(base_names) - {r.name for r in records}
+        if lost:
+            counted = rejected + salvaged + (1 if stats.bytes_lost else 0)
+            check(report, f"{tag}:rejections_counted", counted > 0,
+                  f"{len(lost)} lost, {rejected} rejections, "
+                  f"{int(salvaged)} resyncs, {stats.bytes_lost}B lost")
+        if hints.get("expect_reasons") and (lost or rejected):
+            moved = set(stats.invalid_records) & hints["expect_reasons"]
+            check(report, f"{tag}:reason_labeled", bool(moved),
+                  f"moved={sorted(stats.invalid_records)} "
+                  f"expected one of {sorted(hints['expect_reasons'])}")
+        # a framing loss truncates lenient decode to a valid prefix; a
+        # content-level skip costs exactly the hit record in both modes
+        if hints.get("prefix_only") or (policy == "lenient"
+                                        and hints.get("prefix_lenient")):
+            got = [r.name for r in records]
+            check(report, f"{tag}:prefix_preserved",
+                  got == base_names[:len(got)])
+        if hints.get("lost_names") is not None:
+            check(report, f"{tag}:exact_loss",
+                  lost == hints["lost_names"], f"lost={sorted(lost)}")
+        if policy == "salvage" and hints.get("max_lost_salvage") is not None:
+            check(report, f"{tag}:salvage_recovery",
+                  len(lost) <= hints["max_lost_salvage"],
+                  f"{len(lost)} lost <= {hints['max_lost_salvage']}")
+
+
+# --------------------------------------------------------- consensus parity
+
+def leg_consensus_parity(tmp: str, report: dict) -> None:
+    """Acceptance invariant: valid records' CONSENSUS output is
+    byte-identical to the uncorrupted run (decode identity implies it,
+    but this leg proves it end to end through the polish pipeline)."""
+    print("== leg: consensus parity under corruption ==")
+    from pbccs_tpu.models.arrow.params import decode_bases, encode_bases
+    from pbccs_tpu.pipeline import Chunk, Subread, process_chunks
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(CONSENSUS_SEED)
+    w = BamWorkload.__new__(BamWorkload)
+    text = bamio.BamHeader(
+        read_groups=[bamio.ReadGroupInfo("fuzzc")]).to_text().encode()
+    w.header_blob = (b"BAM\x01" + struct.pack("<i", len(text)) + text
+                     + struct.pack("<i", 0))
+    w.records, w.rec_blobs = [], []
+    for i in range(6):
+        _, reads, _, snr = simulate_zmw(rng, 60, 5)
+        for k, r in enumerate(reads):
+            rec = bamio.BamRecord(
+                name=f"fuzzc/{i}/{k}_{k + 1}", seq=decode_bases(r), qual="",
+                tags={"zm": i, "cx": 3, "rq": 0.85,
+                      "sn": [float(s) for s in snr]})
+            w.records.append(rec)
+            w.rec_blobs.append(bamio.encode_record(rec))
+
+    def chunks_from(records):
+        by_zmw: dict[str, Chunk] = {}
+        for r in records:
+            zid = "/".join(r.name.split("/")[:2])
+            c = by_zmw.setdefault(
+                zid, Chunk(zid, [], np.asarray(r.tags["sn"], np.float64)))
+            c.reads.append(Subread(r.name, encode_bases(r.seq), flags=3,
+                                   read_accuracy=float(r.tags["rq"])))
+        return [by_zmw[k] for k in sorted(by_zmw)]
+
+    # corrupt one subread of ZMW 2 (tag type) -> lenient drops that read
+    hit = next(i for i, r in enumerate(w.records)
+               if r.name.startswith("fuzzc/2/"))
+    blobs = list(w.rec_blobs)
+    blobs[hit] = _mutate_blob(blobs[hit], b"zmi", 2, b"q")
+    clean_path = os.path.join(tmp, "consensus_clean.bam")
+    with open(clean_path, "wb") as f:
+        f.write(w.compress())
+    dirty = w.compress(w.payload(blobs))
+
+    clean_records = list(bamio.BamReader(clean_path, policy="strict"))
+    dirty_records, _, _, _ = _decode(dirty, "lenient", tmp)
+    base = process_chunks(chunks_from(clean_records))
+    fuzz = process_chunks(chunks_from(dirty_records))
+    base_out = {r.id: (r.sequence, r.qualities) for r in base.results}
+    fuzz_out = {r.id: (r.sequence, r.qualities) for r in fuzz.results}
+    untouched = {z for z in base_out if z != "fuzzc/2"}
+    check(report, "consensus:survivor_parity",
+          all(base_out[z] == fuzz_out.get(z) for z in untouched),
+          f"{len(untouched)} untouched ZMWs byte-identical")
+
+
+# ------------------------------------------------------------ wire protocol
+
+def _stub_server(max_line=4096, idle_s=0.0, cap=64, gate=None):
+    from pbccs_tpu.pipeline import Failure, PreparedZmw
+    from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+    from pbccs_tpu.serve.server import CcsServer
+
+    def prep(chunk, settings):
+        return None, PreparedZmw(chunk, np.zeros(64, np.int8), [],
+                                 len(chunk.reads), 0, 0.0)
+
+    def polish(preps, settings):
+        if gate is not None:
+            gate.wait(10.0)
+        return [(Failure.SUCCESS, None) for _ in preps]
+
+    eng = CcsEngine(config=ServeConfig(
+        max_batch=1, max_wait_ms=20.0, max_line_bytes=max_line,
+        idle_timeout_s=idle_s, max_inflight_per_session=cap),
+        prep_fn=prep, polish_fn=polish).start()
+    srv = CcsServer(eng, port=0).start()
+    return eng, srv
+
+
+def _session(srv, timeout=10.0):
+    conn = socket.create_connection((srv.host, srv.port), timeout=timeout)
+    return conn, conn.makefile("rb")
+
+
+def _reply(rf):
+    line = rf.readline()
+    return json.loads(line) if line else None
+
+
+def leg_wire(report: dict) -> None:
+    print("== leg: wire-protocol armor ==")
+    from pbccs_tpu.serve import protocol
+
+    scope = _REG.scope()
+    eng, srv = _stub_server(max_line=4096, idle_s=0.5, cap=2)
+    try:
+        # oversized frame -> bad_request, session closed, abort counted
+        conn, rf = _session(srv)
+        conn.sendall(b"a" * 8192)
+        msg = _reply(rf)
+        check(report, "wire:oversized_frame:bad_request",
+              msg is not None and msg.get("code") == "bad_request",
+              str(msg)[:80])
+        check(report, "wire:oversized_frame:session_closed",
+              rf.readline() == b"")
+        conn.close()
+
+        # binary garbage -> bad_request, session SURVIVES
+        conn, rf = _session(srv)
+        conn.sendall(b"\xff\xfe\x00garbage\n")
+        msg = _reply(rf)
+        check(report, "wire:binary_garbage:bad_request",
+              msg.get("code") == "bad_request")
+        conn.sendall(protocol.encode_msg({"verb": "ping", "id": "p"}))
+        check(report, "wire:binary_garbage:session_survives",
+              _reply(rf).get("type") == "pong")
+        conn.close()
+
+        # structurally bad JSON + invalid zmw payloads -> structured
+        # rejections, each with a machine-readable reason
+        conn, rf = _session(srv)
+        for payload in (
+                b"{not json\n",
+                b'{"verb":"submit","id":"x","zmw":"nope"}\n',
+                b'{"verb":"submit","id":"x","zmw":{"id":"m/1",'
+                b'"snr":[1,2,3],"reads":[{"seq":"ACGT"}]}}\n',
+                b'{"verb":"submit","id":"x","zmw":{"id":"m/1",'
+                b'"reads":[{"seq":"ACGT","accuracy":7}]}}\n',
+                b'{"verb":"submit","id":"x","zmw":{"id":"m/1",'
+                b'"reads":[{"seq":""}]}}\n'):
+            conn.sendall(payload)
+            msg = _reply(rf)
+            if msg.get("code") != "bad_request":
+                check(report, "wire:bad_zmw:rejected", False,
+                      f"{payload[:40]!r} -> {msg}")
+        check(report, "wire:bad_zmw:rejected", True, "5 payloads")
+        conn.sendall(protocol.encode_msg({"verb": "ping", "id": "p"}))
+        check(report, "wire:bad_zmw:session_survives",
+              _reply(rf).get("type") == "pong")
+        conn.close()
+
+        # idle session -> reaped with a `closed` notice
+        conn, rf = _session(srv)
+        t0 = time.monotonic()
+        msg = _reply(rf)  # blocks until the reaper speaks
+        check(report, "wire:idle_session:reaped",
+              msg is not None and msg.get("type") == "closed"
+              and msg.get("reason") == "idle_timeout",
+              f"after {time.monotonic() - t0:.2f}s")
+        check(report, "wire:idle_session:closed", rf.readline() == b"")
+        conn.close()
+    finally:
+        srv.shutdown()
+        eng.close()
+
+    # in-flight cap: gate the polish so submits stack up
+    import threading
+    gate = threading.Event()
+    eng, srv = _stub_server(cap=2, gate=gate)
+    try:
+        conn, rf = _session(srv)
+        for i in range(3):
+            conn.sendall(json.dumps(
+                {"verb": "submit", "id": f"r{i}",
+                 "zmw": {"id": f"m/{i}",
+                         "reads": [{"seq": "ACGTACGT"}] * 4}}).encode()
+                + b"\n")
+        msgs = [_reply(rf) for _ in range(1)]
+        check(report, "wire:inflight_cap:rejected",
+              msgs[0].get("code") == "overloaded"
+              and "in-flight cap" in msgs[0].get("error", ""),
+              str(msgs[0])[:90])
+        gate.set()
+        done = [_reply(rf) for _ in range(2)]
+        check(report, "wire:inflight_cap:others_complete",
+              all(m and m.get("type") == "result" for m in done))
+        conn.close()
+    finally:
+        gate.set()
+        srv.shutdown()
+        eng.close()
+    aborts = scope.counters("ccs_serve_session_aborts_total")
+    causes = {dict(k).get("cause") for k in aborts if aborts[k] > 0}
+    check(report, "wire:aborts_counted",
+          {"oversized_frame", "idle_timeout"} <= causes,
+          f"causes={sorted(causes)}")
+    check(report, "wire:cap_counted", scope.counter_value(
+        "ccs_serve_inflight_cap_rejects_total") >= 1)
+
+
+# ------------------------------------------------------------ drain (TERM)
+
+def leg_drain(report: dict) -> None:
+    """kill -TERM a real `ccs serve` with requests PARKED in the dynamic
+    batcher (a 30 s flush wait guarantees they are in flight when the
+    signal lands): it must announce the drain, flush + answer every one,
+    and exit 0.  The workload mirrors chaos_smoke's 6-ZMW cell so the
+    drain-triggered polish hits the same compiled-program cache."""
+    print("== leg: SIGTERM graceful drain ==")
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.serve import protocol
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(CONSENSUS_SEED)
+    zmws = []
+    for i in range(6):
+        _, reads, _, snr = simulate_zmw(rng, 60, 5)
+        zmws.append({"id": f"smoke/{i}", "snr": [float(s) for s in snr],
+                     "reads": [{"seq": decode_bases(r)} for r in reads]})
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbccs_tpu.cli", "serve", "--port", "0",
+         "--maxBatch", "16", "--maxWaitMs", "30000",
+         "--drainTimeout", "300", "--logLevel", "ERROR"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        line = proc.stdout.readline()
+        check(report, "drain:ready", line.startswith("CCS-SERVE-READY"),
+              line.strip())
+        _, host, port = line.split()
+        conn = socket.create_connection((host, int(port)), timeout=300.0)
+        rf = conn.makefile("rb")
+        for i, z in enumerate(zmws):
+            conn.sendall(protocol.encode_msg(
+                {"verb": "submit", "id": f"d{i}", "zmw": z}))
+        # confirm every submit is admitted-and-parked (the 30 s flush
+        # wait means none can complete) before the signal lands
+        conn.sendall(protocol.encode_msg({"verb": "status", "id": "st"}))
+        status = _reply(rf)
+        while status is not None and status.get("id") != "st":
+            status = _reply(rf)
+        check(report, "drain:in_flight_before_term",
+              status is not None and status.get("pending") == len(zmws),
+              f"pending={status and status.get('pending')}")
+        proc.send_signal(signal.SIGTERM)
+        results = {}
+        while len(results) < len(zmws):
+            msg = _reply(rf)
+            if msg is None:
+                break
+            if msg.get("type") == "result":
+                results[msg.get("id")] = msg.get("status")
+            elif msg.get("type") == "error":
+                results[msg.get("id")] = msg.get("code")
+        check(report, "drain:in_flight_answered",
+              len(results) == len(zmws), f"statuses={sorted(results.items())}")
+        drain_line = proc.stdout.readline()
+        check(report, "drain:announced",
+              drain_line.startswith("CCS-SERVE-DRAINING"),
+              drain_line.strip())
+        rc = proc.wait(timeout=300)
+        check(report, "drain:exit_zero", rc == 0, f"exit {rc}")
+        check(report, "drain:results_not_aborted",
+              all(s not in ("closed", "internal") for s in results.values()),
+              f"{sorted(set(results.values()))}")
+        conn.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+
+# ------------------------------------------------------------------- driver
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="deterministic tier-1 leg: every class once + "
+                        "consensus parity + wire armor + TERM drain")
+    p.add_argument("--rounds", type=int, default=0,
+                   help="extra randomized decode rounds (chaos_bench)")
+    p.add_argument("--only", default=None,
+                   help="run one corruption class (e.g. bam:bitflip)")
+    p.add_argument("--skip-subprocess", action="store_true",
+                   help="skip the TERM-drain subprocess leg")
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from pbccs_tpu.runtime.logging import Logger, LogLevel
+
+    Logger.default(Logger(level=LogLevel.FATAL))
+    report: dict = {"seed": args.seed}
+    failed = False
+    tmp = tempfile.mkdtemp(prefix="fuzz_inputs_")
+    try:
+        classes = [(n, f) for n, f in BAM_CLASSES
+                   if args.only in (None, n)]
+        if classes:
+            workload = BamWorkload(args.seed)
+            # self-check: the uncorrupted workload decodes losslessly
+            clean, stats, _, _ = _decode(workload.compress(), "strict", tmp)
+            check(report, "workload:clean_roundtrip",
+                  [r.name for r in clean]
+                  == [r.name for r in workload.records]
+                  and stats.total_invalid == 0,
+                  f"{len(clean)} records, multi-block="
+                  f"{len(workload.payload()) > 2 * 64 * 1024}")
+            for name, fn in classes:
+                run_bam_case(name, fn, workload, args.seed, tmp, report)
+            for r in range(args.rounds):
+                seed_r = args.seed * 1000 + r + 1
+                name, fn = classes[r % len(classes)]
+                run_bam_case(name, fn, workload, seed_r, tmp, report)
+        if args.smoke and args.only is None:
+            leg_wire(report)
+            leg_consensus_parity(tmp, report)
+            if not args.skip_subprocess:
+                leg_drain(report)
+        elif args.only and args.only.startswith("wire:"):
+            leg_wire(report)
+        elif args.only == "drain":
+            leg_drain(report)
+    except CheckFailed as e:
+        report["failed"] = str(e)
+        failed = True
+
+    out = json.dumps(report, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    print("fuzz_inputs:", "FAILED" if failed else "all checks passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,10 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 echo "== chaos smoke (fault injection / quarantine / watchdog) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py || exit 1
 
+echo "== fuzz smoke (hostile-input hardening: BAM salvage / wire armor / drain) =="
+# deterministic: any finding reproduces with --seed 0 --only <CLASS>
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --smoke --seed 0 || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
