@@ -197,6 +197,10 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     mean_qv = float(np.mean([q.mean() for q in qvs]))
     return {
         "zmws_per_sec": n_zmws / bench_s,
+        # effective overlapped-worker count (BENCH_WORKERS clamped to the
+        # batch count): a single-batch row runs unoverlapped regardless
+        # of the requested setting, and the sweep tag must say so
+        "workers": workers,
         "bench_s": bench_s,
         "bench_s_min": float(np.min(run_times)),
         "bench_s_max": float(np.max(run_times)),
@@ -339,6 +343,13 @@ SWEEP_CONFIGS = [
     # inter-batch gaps to hide and stays unoverlapped.
     ("cfg2_2kb_3-10p", 128, 2000, "3-10", 2, 32, 1, {"BENCH_WORKERS": "2"}),
     ("cfg4_30px500bp", 64, 500, "30", 2, 32, 3, {"BENCH_WORKERS": "2"}),
+    # unoverlapped (workers=1) twins of the overlapped rows: speedup-over-
+    # reference claims stay apples-to-apples with the single-threaded
+    # reference C++ (every row now carries a `workers` tag; the _w1 rows
+    # reuse the base row's reference number -- identical workload)
+    ("cfg2_2kb_3-10p_w1", 128, 2000, "3-10", 2, 32, 1,
+     {"BENCH_WORKERS": "1"}),
+    ("cfg4_30px500bp_w1", 64, 500, "30", 2, 32, 3, {"BENCH_WORKERS": "1"}),
     # 15 kb runs DEVICE-RESIDENT since the circular-lane kernels: the
     # round-4 compile wall (>40 min, PROFILE_r04) is gone (~2 min cold,
     # persistent-cached after), and the warm loop runs the whole 15 kb
@@ -399,6 +410,12 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
         entry = {
             "name": name, "n_zmws": z, "tpl_len": L, "n_passes": passes,
             "batch": batch,
+            # EFFECTIVE overlapped-worker count this row ran with (bench()
+            # clamps BENCH_WORKERS to the batch count): rows are only
+            # comparable at equal workers, and speedup-over-reference
+            # claims must cite a workers=1 row (the reference C++ is
+            # single-threaded)
+            "workers": int(stats["workers"]),
             "zmws_per_sec": round(stats["zmws_per_sec"], 4),
             "bench_s": round(stats["bench_s"], 4),
             "repeats": stats["repeats"],
@@ -410,7 +427,11 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
         }
         if env:
             entry["env"] = env
-        ref = (ref_cfgs.get(name) or {}).get("reference_cpp_zmws_per_sec")
+        # _w1 twin rows run the identical workload as their base row, so
+        # they share its recorded reference C++ number
+        base_name = name[:-3] if name.endswith("_w1") else name
+        ref = (ref_cfgs.get(base_name) or {}).get(
+            "reference_cpp_zmws_per_sec")
         if ref:
             entry["reference_cpp_zmws_per_sec"] = ref
             entry["vs_reference_cpp"] = round(stats["zmws_per_sec"] / ref, 4)
@@ -418,7 +439,7 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
         # this entry's n_zmws on the bench accuracy draw, REFBENCH_DRAW=2 --
         # converged/mean_qv are draw-dependent, so only a same-draw row is
         # an honest accuracy bar; docs/ACCURACY.md)
-        matched = ref_cfgs.get(f"{name}_z{z}_draw2")
+        matched = ref_cfgs.get(f"{base_name}_z{z}_draw2")
         if matched:
             entry["reference_cpp_accuracy_same_draw"] = {
                 "converged": matched.get("converged"),
@@ -509,6 +530,118 @@ def bench_quiver(n_zmws: int = 4, tpl_len: int = 120,
         raise RuntimeError(f"quiver bench subprocess failed: "
                            f"{out.stderr[-500:]}")
     return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _bench_sched_impl(n_zmws: int, tpl_len: int, n_passes, n_corr: int,
+                      batch: int) -> dict:
+    """Device-fleet scheduler scaling: the same batched workload through
+    a 1-device and an 8-device DevicePool (pbccs_tpu/sched), identical
+    group composition, byte-identity checked.  Meant to run under
+    JAX_PLATFORMS=cpu + XLA_FLAGS=--xla_force_host_platform_device_count=8
+    (bench_sched arranges that); on a 1-2 core host the virtual devices
+    share the physical cores, so the measured speedup is a LOWER bound on
+    what a real multi-chip host sees (the scheduling overhead is real,
+    the parallel compute is not)."""
+    import numpy as np
+
+    import jax
+
+    from pbccs_tpu.sched import DevicePool, DevicePoolConfig
+
+    rng = np.random.default_rng(20260729)
+    tasks, _ = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corr)
+    groups = [tasks[lo: lo + batch] for lo in range(0, n_zmws, batch)]
+
+    def group_fn(g):
+        return lambda _device: run_workload(g)
+
+    def run_all(pool):
+        futs = [pool.submit("sched-bench", group_fn(g), zmws=len(g))
+                for g in groups]
+        outs = [f.result() for f in futs]
+        tpls = [t for p, _, _ in outs for t in p.tpls[: p.n_zmws]]
+        qvs = [q for _, _, qs in outs for q in qs]
+        return tpls, qvs
+
+    devices = jax.devices()
+    # warm EVERY device at EVERY distinct group shape (a non-divisible
+    # n_zmws/batch leaves a straggler group with its own compiled
+    # shapes): executables cache per device, and a cold compile inside a
+    # timed pass would masquerade as scheduler overhead
+    warm_groups = {len(g): g for g in groups}.values()
+    with DevicePool(devices) as warm:
+        # pin=True: a warm task that fails must surface, not silently
+        # requeue elsewhere and leave this device cold for the timed pass
+        futs = [warm.submit("warm", group_fn(g), worker_index=i, pin=True)
+                for g in warm_groups for i in range(len(devices))]
+        for f in futs:
+            f.result()
+
+    with DevicePool(devices[:1]) as single:
+        t0 = time.monotonic()
+        tpl1, qv1 = run_all(single)
+        t_1 = time.monotonic() - t0
+    with DevicePool(devices, DevicePoolConfig(policy="sticky")) as multi:
+        t0 = time.monotonic()
+        tpl_n, qv_n = run_all(multi)
+        t_n = time.monotonic() - t0
+    identical = (
+        len(tpl1) == len(tpl_n)
+        and all(np.array_equal(a, b) for a, b in zip(tpl1, tpl_n))
+        and all(np.array_equal(a, b) for a, b in zip(qv1, qv_n)))
+    # a caller-preset xla_force_host_platform_device_count (bench_sched
+    # only appends =8 when absent) changes the fleet size: name the row
+    # by what actually ran so cross-run comparisons can't mix fleets
+    return {
+        "name": f"sched_{len(devices)}dev_virtual",
+        "n_zmws": n_zmws, "tpl_len": tpl_len, "n_passes": n_passes,
+        "batch": batch, "devices": len(devices),
+        "host_cpus": os.cpu_count(),
+        "zmws_per_sec_1dev": round(n_zmws / t_1, 4),
+        f"zmws_per_sec_{len(devices)}dev": round(n_zmws / t_n, 4),
+        "speedup": round(t_1 / t_n, 3),
+        "identical_output": identical,
+        "note": "virtual CPU devices share the host cores; speedup is a "
+                "lower bound for a real multi-chip host",
+    }
+
+
+def bench_sched() -> dict:
+    """The multi-device scheduler leg, in a subprocess that forces 8
+    virtual CPU devices (the device-count flag must be set before the
+    backend initializes, and the parent may already hold a TPU)."""
+    import subprocess
+
+    n_zmws = int(os.environ.get("BENCH_SCHED_ZMWS", 64))
+    tpl_len = int(os.environ.get("BENCH_SCHED_TPL_LEN", 300))
+    passes = os.environ.get("BENCH_SCHED_PASSES", "8")
+    batch = int(os.environ.get("BENCH_SCHED_BATCH", 8))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import os, sys, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'xla_force_host_platform_device_count' not in flags:\n"
+        "    os.environ['XLA_FLAGS'] = (flags + "
+        "' --xla_force_host_platform_device_count=8').strip()\n"
+        "os.environ.setdefault('PBCCS_DEVICE_REFINE', '0')\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from pbccs_tpu.runtime.cache import enable_compilation_cache\n"
+        "enable_compilation_cache()\n"
+        "from bench import _bench_sched_impl\n"
+        f"s = _bench_sched_impl({n_zmws}, {tpl_len}, {passes!r}, 2, "
+        f"{batch})\n"
+        "print('RESULT::' + json.dumps(s))\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=float(os.environ.get("BENCH_SCHED_TIMEOUT", 1800)))
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT::"):
+            return json.loads(line[len("RESULT::"):])
+    raise RuntimeError(f"sched bench subprocess rc={proc.returncode}: "
+                       f"{proc.stderr[-500:]}")
 
 
 def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
@@ -635,7 +768,7 @@ def main() -> None:
             with open(BASELINE_FILE) as f:
                 ref_cfgs = json.load(f).get("configs", {})
         configs = bench_sweep(ref_cfgs)
-        for extra in (bench_quiver, bench_streamed):
+        for extra in (bench_quiver, bench_streamed, bench_sched):
             try:
                 configs.append(extra())
             except Exception as e:  # noqa: BLE001

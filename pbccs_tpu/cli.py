@@ -130,11 +130,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "movie:1-3,5;movie2:*. Default = %(default)s")
     add_consensus_args(p)
     p.add_argument("--numThreads", type=int, default=0,
-                   help="Number of host pipeline threads (0 = auto). "
-                        "Default = %(default)s")
+                   help="Number of host pipeline threads (0 = auto); with "
+                        "--devices it seeds the prepare pool unless "
+                        "--prepareWorkers is given. Default = %(default)s")
     p.add_argument("--chunkSize", type=int, default=64,
                    help="ZMWs per work item; each work item polishes as one "
                         "lockstep device batch. Default = %(default)s")
+    p.add_argument("--devices", type=int, default=1,
+                   help="Polish across a device fleet (pbccs_tpu.sched): "
+                        "N>1 uses the first N visible devices, 0 all of "
+                        "them, 1 the legacy single-device WorkQueue "
+                        "driver. Default = %(default)s")
+    p.add_argument("--prepareWorkers", type=int, default=0,
+                   help="Host prepare (POA draft) threads overlapping "
+                        "in-flight device polishes in the scheduled "
+                        "driver (0 = auto; only used with --devices). "
+                        "Default = %(default)s")
+    p.add_argument("--schedPolicy", choices=("sticky", "least", "roundrobin"),
+                   default="sticky",
+                   help="Device-fleet routing: sticky keeps a compiled-"
+                        "shape bucket on the device that already compiled "
+                        "it (least-loaded otherwise). "
+                        "Default = %(default)s")
     p.add_argument("--logFile", default=None, help="Log to a file vs stderr.")
     p.add_argument("--logLevel", default="INFO",
                    help="TRACE..FATAL. Default = %(default)s")
@@ -321,6 +338,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.serve.server import run_serve
 
         return run_serve(argv[1:])
+    if argv and argv[0] == "warmup":
+        # `ccs warmup`: precompile a declared bucket menu (pbccs_tpu/sched)
+        from pbccs_tpu.sched.warmup import run_warmup
+
+        return run_warmup(argv[1:])
     args = build_parser().parse_args(argv)
     apply_resilience_args(args)
 
@@ -337,6 +359,11 @@ def run(argv: list[str] | None = None) -> int:
         whitelist = Whitelist(args.zmws)
     except ValueError as e:
         print(f"option --zmws: invalid specification: {e}", file=sys.stderr)
+        return 2
+
+    if args.devices < 0:
+        print(f"option --devices: must be >= 0, got {args.devices}",
+              file=sys.stderr)
         return 2
 
     settings = consensus_settings_from_args(args)
@@ -467,49 +494,104 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
             restored = {i: t for i, t in restored.items() if i < k}
         journal.start(fp, resume=args.resume and bool(restored))
 
-    def _run_batch(idx, batch):
-        return idx, process_chunks(batch, settings,
-                                   on_error=args.batchFallback)
-
-    consumed = ResultTally()
-    consumer_error: list[BaseException] = []
-
-    with WorkQueue(n_threads) as wq:
-        def _consume():
-            try:
-                for idx, sub_tally in wq.results():
-                    consumed.merge(sub_tally)
-                    if journal is not None:
-                        journal.record_chunk(idx, sub_tally)
-            except BaseException as e:  # noqa: BLE001 -- re-raised below
-                consumer_error.append(e)
-
-        consumer = threading.Thread(target=_consume, name="pbccs-consumer")
-        consumer.start()
-        it = iter(_chunks_from_files(files, whitelist, args, log, tally))
+    def _read_batches(gate_tally: ResultTally):
+        """Shared reader loop of BOTH drivers: stream (idx, batch) with
+        read-stage timing and output-header movie registration.  CLI-gate
+        skips tally into `gate_tally` (the fleet driver passes a separate
+        one because this generator runs on its feeder thread)."""
+        it = iter(_chunks_from_files(files, whitelist, args, log,
+                                     gate_tally))
         idx = -1
         while True:
             with timing.stage("read"):
                 batch = next(it, None)
             if batch is None:
-                break
+                return
             idx += 1
             for chunk in batch:
                 movie = chunk.id.split("/")[0]
                 movies.setdefault(movie, ReadGroupInfo(movie, "CCS"))
-            if idx in restored:
-                # journaled chunks restore in index order BEFORE any
-                # newly computed chunk merges (journal records form a
-                # prefix), so output order matches an uninterrupted run
-                tally.merge(restored[idx])
-                continue
-            with timing.stage("queue"):
-                wq.produce(_run_batch, idx, batch)
-        wq.finalize()
-        consumer.join()
-    if consumer_error:
-        raise consumer_error[0]
-    tally.merge(consumed)
+            yield idx, batch
+
+    if args.devices != 1:
+        # Device-fleet scheduler (pbccs_tpu/sched): host prepare workers
+        # overlap in-flight device polishes and batches fan out across
+        # the pool with sticky bucket routing.  Batch composition and
+        # shape derivation are IDENTICAL to the WorkQueue driver (same
+        # --chunkSize groups, same effective_shapes), so the output is
+        # byte-identical to a --devices 1 run.
+        from pbccs_tpu.sched import (DevicePool, DevicePoolConfig,
+                                     select_devices)
+        from pbccs_tpu.sched.executor import ScheduledPipeline
+
+        devs = select_devices(args.devices)
+        # --numThreads sizes the legacy WorkQueue driver; in fleet mode
+        # it seeds the host prepare pool instead of being silently
+        # dropped (an explicit --prepareWorkers still wins)
+        prep_workers = args.prepareWorkers or args.numThreads or max(
+            2, min(4, os.cpu_count() or 1))
+        pool = DevicePool(devs, DevicePoolConfig(policy=args.schedPolicy),
+                          logger=log)
+        pipe = ScheduledPipeline(pool, settings,
+                                 prepare_workers=prep_workers,
+                                 on_error=args.batchFallback, logger=log)
+
+        # the reader runs on the pipeline's feeder thread, so its
+        # CLI-gate skips tally into their own ResultTally (merged below)
+        # instead of racing the main thread's result merges;
+        # journal-restored chunks ride through the scheduler as
+        # precomputed tallies so they merge at their index slot
+        gate_tally = ResultTally()
+        items = ((idx, batch, restored.get(idx))
+                 for idx, batch in _read_batches(gate_tally))
+        try:
+            for idx, sub_tally in pipe.run(items):
+                tally.merge(sub_tally)
+                if journal is not None and idx not in restored:
+                    journal.record_chunk(idx, sub_tally)
+        except BaseException:
+            # the run is already doomed: fail queued batches fast
+            # (PoolClosed) instead of polishing minutes of device work
+            # whose results nothing will consume
+            pool.close(wait=False)
+            raise
+        pool.close()
+        tally.merge(gate_tally)
+    else:
+        def _run_batch(idx, batch):
+            return idx, process_chunks(batch, settings,
+                                       on_error=args.batchFallback)
+
+        consumed = ResultTally()
+        consumer_error: list[BaseException] = []
+
+        with WorkQueue(n_threads) as wq:
+            def _consume():
+                try:
+                    for idx, sub_tally in wq.results():
+                        consumed.merge(sub_tally)
+                        if journal is not None:
+                            journal.record_chunk(idx, sub_tally)
+                except BaseException as e:  # noqa: BLE001 -- re-raised below
+                    consumer_error.append(e)
+
+            consumer = threading.Thread(target=_consume,
+                                        name="pbccs-consumer")
+            consumer.start()
+            for idx, batch in _read_batches(tally):
+                if idx in restored:
+                    # journaled chunks restore in index order BEFORE any
+                    # newly computed chunk merges (journal records form a
+                    # prefix), so output order matches an uninterrupted run
+                    tally.merge(restored[idx])
+                    continue
+                with timing.stage("queue"):
+                    wq.produce(_run_batch, idx, batch)
+            wq.finalize()
+            consumer.join()
+        if consumer_error:
+            raise consumer_error[0]
+        tally.merge(consumed)
     if journal is not None:
         # a completed run needs no resume point; a later --resume against
         # fresh inputs must not splice stale results

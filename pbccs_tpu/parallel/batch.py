@@ -11,7 +11,9 @@ all-reduce; the ZMW axis is pure data parallelism.
 
 Selection semantics per ZMW are identical to the host refinement loop
 (models/arrow/refine.py): favorable = score above the f32 noise floor
-(refine.favorability_threshold; the reference's `score > 0` in f64),
+(refine.favorability_threshold, recomputed per round -- a deliberate
+scaled-floor deviation from the reference's FIXED +0.04-nat acceptance
+threshold, MultiReadMutationScorer.cpp:56; rationale in docs/PARITY.md),
 greedy well-separated best subset, template-hash cycle avoidance,
 converged ZMWs drop out of the mutation workload (their slots are
 masked, not recompiled away).
@@ -237,6 +239,16 @@ def _update_active_partial(active, ll_a, ll_b, rlens, tstarts, tends,
     rows = prev & real_sub & _mated_mask_dev(ll_a, ll_b, rlens,
                                              tstarts, tends)
     return active.at[idx].set(rows, mode="drop")
+
+
+@jax.jit
+def _favorability_eps(baselines, active):
+    """(Z,) per-round favorability floor from the CURRENT device-side
+    baselines/active mask (refine.favorability_threshold) -- bit-identical
+    to the device-resident loop's in-program computation, so the host
+    fallback loop selects exactly as the device loop does."""
+    return refine_mod.favorability_threshold(
+        jnp.sum(jnp.where(active, jnp.abs(baselines), 0.0), axis=1))
 
 
 @jax.jit
@@ -1318,17 +1330,17 @@ class BatchPolisher:
         for z in (skip or ()):
             done[z] = True
 
-        # f32 score-noise floor, same rule as the device loop and the
-        # per-ZMW host loop (models/arrow/refine.py).  eps is a NOISE
-        # SCALE, not a semantic quantity: computed ONCE from the
-        # AddRead-time magnitudes (one stats fetch, not one per round);
-        # round-over-round drift of sum |baseline| is percent-level and
-        # immaterial to a rounding-error threshold.
-        eps_z = refine_mod.favorability_threshold(
-            np.where(self.active, np.abs(self.baselines), 0.0).sum(1))
-
         empty = mutlib.MutationArrays(*(np.zeros(0, np.int32),) * 4)
         for it in range(budget):
+            # f32 score-noise floor, recomputed PER ROUND from the current
+            # device-side baselines/active mask -- the same favorability
+            # rule (and the same f32 arithmetic) as the device-resident
+            # loop and the per-round serial scorer, so all three polish
+            # paths select identically.  One tiny (Z,)-fetch per round;
+            # this loop is already the fetch-per-round fallback path.
+            eps_z = device_fetch(
+                _favorability_eps(self._baselines_dev, self._active_dev),
+                np.float64)
             arrs: list[mutlib.MutationArrays] = []
             for z in range(Z):
                 if done[z]:

@@ -765,9 +765,12 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         totals, fb_any = score_all(st, start, end, mtype, base, valid)
         scores = jnp.where(valid, totals, -jnp.inf)
         # favorability above the f32 score-noise floor (one source of
-        # truth: refine.favorability_threshold) -- sub-noise deltas at
-        # long templates read "favorable" in BOTH directions of an
-        # ins/del pair and ping-pong the loop to its budget
+        # truth: refine.favorability_threshold; the scaled floor is a
+        # deliberate deviation from the reference's fixed +0.04-nat
+        # acceptance, MultiReadMutationScorer.cpp:56 -- docs/PARITY.md)
+        # -- sub-noise deltas at long templates read "favorable" in BOTH
+        # directions of an ins/del pair and ping-pong the loop to its
+        # budget
         from pbccs_tpu.models.arrow.refine import favorability_threshold
         eps_z = favorability_threshold(jnp.sum(
             jnp.where(st.active, jnp.abs(st.baselines), 0.0), axis=1))

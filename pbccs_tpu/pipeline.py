@@ -690,7 +690,8 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
                           settings: ConsensusSettings | None = None, *,
                           buckets: tuple[int, int, int] | None = None,
                           min_z: int = 1,
-                          on_error: str = "bisect"
+                          on_error: str = "bisect",
+                          raise_device_shaped: bool = False
                           ) -> list[tuple[Failure, ConsensusResult | None]]:
     """Polish a batch of prepared ZMWs in one lockstep BatchPolisher and
     return per-ZMW outcomes ALIGNED with `preps` -- the polish core shared
@@ -712,7 +713,17 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
     k poison ZMW(s); on_error="serial" keeps the legacy whole-batch
     serial fallback.  Either way a ZMW that fails even its serial rescue
     is quarantined (logged + counted, optionally degraded to draft-only
-    consensus) instead of silently reporting Failure.OTHER."""
+    consensus) instead of silently reporting Failure.OTHER.
+
+    `raise_device_shaped=True` (the device-fleet drivers' FIRST attempt
+    at a batch) re-raises hardware-shaped failures -- a WatchdogTimeout,
+    a persistent XLA runtime error, a RetriesExhausted -- instead of
+    quarantining: bisecting on the device that just hung would burn
+    O(Z log Z) timeouts on the same sick hardware, while re-raising lets
+    the DevicePool strike/bench it and requeue the WHOLE batch to a
+    healthy device.  Injected poison-ZMW faults (resilience.faults
+    InjectedFault at polish.dispatch) are task-shaped and always stay on
+    the quarantine path."""
     settings = settings or ConsensusSettings()
     if settings.model == "quiver":
         # Quiver has no lockstep batch driver: it polishes per ZMW (its
@@ -729,8 +740,13 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
         return _guarded_dispatch(preps, settings, buckets=buckets,
                                  min_z=min_z)
     except Exception as e:  # noqa: BLE001 -- quarantine the poison
-        from pbccs_tpu.resilience import quarantine
+        from pbccs_tpu.resilience import quarantine, retry, watchdog
 
+        if raise_device_shaped and (
+                isinstance(e, (watchdog.WatchdogTimeout,
+                               retry.RetriesExhausted))
+                or type(e).__name__ == "XlaRuntimeError"):
+            raise
         if on_error == "serial":
             # legacy fault isolation (reference Consensus.h:543-548):
             # re-run every ZMW through the serial pipeline, each with
@@ -747,6 +763,36 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
             settings, e)
 
 
+def prepare_batch(chunks: Sequence[Chunk],
+                  settings: ConsensusSettings | None = None
+                  ) -> tuple[ResultTally, list[PreparedZmw]]:
+    """The host half of a batch: run every chunk through the prep stages
+    (filter -> POA draft -> mapping) with per-ZMW fault isolation,
+    returning (tally of prep-stage outcomes, survivors ready to polish).
+    Shared by process_chunks and the device-fleet scheduler's prepare
+    workers (pbccs_tpu.sched.executor), so the two drivers cannot drift."""
+    from pbccs_tpu.resilience import faults
+    from pbccs_tpu.runtime import timing
+
+    settings = settings or ConsensusSettings()
+    tally = ResultTally()
+    preps: list[PreparedZmw] = []
+    with timing.stage("draft"):
+        for chunk in chunks:
+            try:
+                faults.maybe_fail("prep.zmw", keys=[chunk.id])
+                failure, prep = prepare_chunk(chunk, settings)
+            except Exception as e:  # noqa: BLE001 -- per-ZMW isolation
+                record_zmw_failure("prepare", e, zmw=chunk.id)
+                tally.tally(Failure.OTHER)
+                continue
+            if failure is not None:
+                tally.tally(failure)
+            else:
+                preps.append(prep)
+    return tally, preps
+
+
 def process_chunks(chunks: Sequence[Chunk],
                    settings: ConsensusSettings | None = None,
                    batch_polish: bool = True,
@@ -760,8 +806,6 @@ def process_chunks(chunks: Sequence[Chunk],
     the TPU execution model (one batched device program per refinement
     round) instead of the reference's one-thread-per-ZMW loop.  `on_error`
     selects the batch-failure recovery (see polish_prepared_batch)."""
-    from pbccs_tpu.resilience import faults
-
     settings = settings or ConsensusSettings()
     tally = ResultTally()
     # the lockstep BatchPolisher is the Arrow device path; Quiver polishes
@@ -779,22 +823,8 @@ def process_chunks(chunks: Sequence[Chunk],
                 tally.results.append(result)
         return tally
 
-    from pbccs_tpu.runtime import timing
-
-    preps: list[PreparedZmw] = []
-    with timing.stage("draft"):
-        for chunk in chunks:
-            try:
-                faults.maybe_fail("prep.zmw", keys=[chunk.id])
-                failure, prep = prepare_chunk(chunk, settings)
-            except Exception as e:  # noqa: BLE001 -- per-ZMW isolation
-                record_zmw_failure("prepare", e, zmw=chunk.id)
-                tally.tally(Failure.OTHER)
-                continue
-            if failure is not None:
-                tally.tally(failure)
-            else:
-                preps.append(prep)
+    prep_tally, preps = prepare_batch(chunks, settings)
+    tally.merge(prep_tally)
     if not preps:
         return tally
 

@@ -22,6 +22,7 @@ its own ServeConfig.polish_timeout_ms explicitly.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 from typing import Callable, TypeVar
 
@@ -63,10 +64,29 @@ def default_deadline_s() -> float:
         return 0.0
 
 
+def _ambient_jax_device():
+    """The caller's thread-local jax default_device (None when jax is not
+    imported or no override is active).  jax.default_device is a
+    THREAD-LOCAL config scope, and run_with_deadline moves the guarded
+    callable onto a fresh thread: without carrying the override across,
+    a device-fleet dispatch (pbccs_tpu/sched runs each task under
+    jax.default_device on ITS worker thread) would silently land on the
+    process-default device whenever a watchdog deadline is armed."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.config.jax_default_device
+    except Exception:  # noqa: BLE001 -- best-effort carry
+        return None
+
+
 def run_with_deadline(fn: Callable[[], T], timeout_s: float | None = None,
                       *, site: str = "") -> T:
     """Run fn() with a deadline; timeout_s None uses the ambient default,
-    and <= 0 disables the wrapper entirely (fn runs on this thread)."""
+    and <= 0 disables the wrapper entirely (fn runs on this thread).
+    The caller's thread-local jax default_device carries over to the
+    worker thread (see _ambient_jax_device)."""
     if timeout_s is None:
         timeout_s = default_deadline_s()
     if not timeout_s or timeout_s <= 0:
@@ -75,10 +95,19 @@ def run_with_deadline(fn: Callable[[], T], timeout_s: float | None = None,
     done = threading.Event()
     abandoned = threading.Event()
     box: list = []          # [("ok", result)] or [("err", exc)]
+    ambient_device = _ambient_jax_device()   # read on the CALLER's thread
+
+    def call():
+        if ambient_device is None:
+            return fn()
+        import jax
+
+        with jax.default_device(ambient_device):
+            return fn()
 
     def target() -> None:
         try:
-            box.append(("ok", fn()))
+            box.append(("ok", call()))
         except BaseException as e:  # noqa: BLE001 -- re-raised by the
             # caller, or logged at debug if it already timed out
             box.append(("err", e))

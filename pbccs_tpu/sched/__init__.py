@@ -1,0 +1,19 @@
+"""Device-fleet scheduler: pipelined multi-device dispatch shared by the
+batch CLI (`--devices`) and the serve engine (`ServeConfig.devices`).
+
+  * pool.py      DevicePool / per-device executor threads, sticky bucket
+                 routing, health-based benching + requeue
+  * executor.py  ScheduledPipeline: host prepare pool overlapped with
+                 in-flight device polishes, ordered result emission
+  * warmup.py    `ccs warmup`: precompile a declared bucket menu
+"""
+
+from pbccs_tpu.sched.pool import (  # noqa: F401
+    DevicePool,
+    DevicePoolConfig,
+    NoHealthyDevice,
+    PoolClosed,
+    SchedFuture,
+    select_devices,
+)
+from pbccs_tpu.sched.executor import ScheduledPipeline  # noqa: F401

@@ -1,0 +1,147 @@
+"""`ccs warmup`: precompile the polish-program menu for declared buckets.
+
+The first polish of a bucket shape pays the XLA compile (~a minute per
+shape set on the tunneled dev TPU, noted in PR 3); a serving engine or a
+production batch run that knows its workload geometry can pay it BEFORE
+traffic instead of inside it.  Each `--bucket ZxPASSESxLEN` entry names a
+compiled-shape bucket by workload geometry -- Z ZMWs per batch, PASSES
+subreads per ZMW, LEN-base templates -- and warmup drives one synthetic
+batch of exactly that geometry through the full polish surface
+(BatchPolisher setup + refine + QV sweep + the straggler-continuation
+shapes), populating the in-process executable cache and the persistent
+compilation cache (runtime/cache.py) that later processes load from.
+
+By default each bucket warms on ONE device (the persistent cache serves
+the other devices' compiles as disk hits); `--allDevices` compiles on
+every visible device for fleets whose per-device executable caches must
+be hot before the first request.
+
+    ccs warmup --bucket 64x8x300 --bucket 16x3x2000 --allDevices
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from pbccs_tpu.runtime.logging import Logger, LogLevel
+
+
+def parse_bucket(spec: str) -> tuple[int, int, int]:
+    """'ZxPASSESxLEN' -> (n_zmws, n_passes, tpl_len)."""
+    parts = spec.lower().split("x")
+    if len(parts) != 3:
+        raise SystemExit(
+            f"--bucket {spec!r}: want ZxPASSESxLEN, e.g. 64x8x300")
+    try:
+        z, p, length = (int(x) for x in parts)
+    except ValueError:
+        raise SystemExit(
+            f"--bucket {spec!r}: want ZxPASSESxLEN, e.g. 64x8x300") from None
+    if min(z, p, length) < 1:
+        raise SystemExit(
+            f"--bucket {spec!r}: want three positive ints ZxPASSESxLEN")
+    return z, p, length
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ccs warmup",
+        description="Precompile the polish-program menu for declared "
+                    "workload buckets (kills the cold-compile latency of "
+                    "the first batch/request at each shape).")
+    p.add_argument("--bucket", action="append", required=True,
+                   metavar="ZxPASSESxLEN",
+                   help="One compiled-shape bucket by workload geometry: "
+                        "Z ZMWs per batch, PASSES subreads per ZMW, "
+                        "LEN-base templates.  Repeatable.")
+    p.add_argument("--devices", type=int, default=0,
+                   help="Devices visible to the warmed fleet (0 = all; "
+                        "bounds what --allDevices compiles on). "
+                        "Default = %(default)s")
+    p.add_argument("--allDevices", action="store_true",
+                   help="Compile every bucket on every device (default: "
+                        "one device; the persistent compilation cache "
+                        "serves the rest as disk hits).")
+    p.add_argument("--logLevel", default="INFO")
+    return p
+
+
+def _warm_one(tasks) -> dict:
+    """Full polish surface at this bucket's shapes; returns the effective
+    compiled shapes (what a matching production batch will reuse)."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher
+
+    opts = RefineOptions()
+    polisher = BatchPolisher(tasks)
+    polisher.refine(opts)
+    polisher.consensus_qvs()
+    polisher.warm_straggler_shapes(opts)
+    return {"Z": polisher._Z, "R": polisher._R,
+            "Jmax": polisher._Jmax, "Imax": polisher._Imax,
+            "W": polisher._W}
+
+
+def _synth_tasks(n_zmws: int, n_passes: int, tpl_len: int):
+    from pbccs_tpu.parallel.batch import ZmwTask
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(20260729)
+    tasks = []
+    for z in range(n_zmws):
+        tpl, reads, strands, snr = simulate_zmw(rng, tpl_len, n_passes)
+        draft = tpl.copy()
+        if tpl_len > 10:  # corrupt so refinement does real mutation work
+            pos = int(rng.integers(5, tpl_len - 5))
+            draft[pos] = (draft[pos] + 1) % 4
+        tasks.append(ZmwTask(f"warmup/{z}", draft, snr, reads, strands,
+                             [0] * n_passes, [len(draft)] * n_passes))
+    return tasks
+
+
+def run_warmup(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import jax
+
+    from pbccs_tpu.sched.pool import select_devices
+
+    try:
+        devices = select_devices(args.devices)
+    except ValueError as e:
+        raise SystemExit(f"--devices: {e}") from None
+    targets = devices if args.allDevices else devices[:1]
+    entries = [parse_bucket(b) for b in args.bucket]
+
+    report = []
+    for (z, passes, length) in entries:
+        tasks = _synth_tasks(z, passes, length)
+        for dev in targets:
+            name = f"{dev.platform}:{dev.id}"
+            log.info(f"warmup: bucket {z}x{passes}x{length} on {name}")
+            t0 = time.monotonic()
+            with jax.default_device(dev):
+                shapes = _warm_one(tasks)
+            dt = time.monotonic() - t0
+            entry = {"bucket": f"{z}x{passes}x{length}", "device": name,
+                     "seconds": round(dt, 2), "shapes": shapes}
+            report.append(entry)
+            log.info(f"warmup: {entry['bucket']} on {name}: "
+                     f"{dt:.1f}s, shapes {shapes}")
+    print(json.dumps({"warmed": report}))
+    log.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_warmup())

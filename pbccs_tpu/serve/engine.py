@@ -74,7 +74,8 @@ _m_latency = _reg.histogram("ccs_serve_request_latency_seconds",
                             buckets=log_buckets(1e-3, 300.0))
 
 
-def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings):
+def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings, *,
+                         raise_device_shaped: bool = False):
     """polish_prepared_batch with shapes pinned to the flush's length
     bucket + pow2 Z/R: online flushes vary in size (1..max_batch ZMWs,
     arbitrary read counts), and letting each draw pick its own shapes
@@ -90,7 +91,8 @@ def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings):
     r = next_pow2(max(len(p.mapped) for p in preps), 4)
     return polish_prepared_batch(preps, settings,
                                  buckets=(imax, jmax, r),
-                                 min_z=next_pow2(len(preps), 4))
+                                 min_z=next_pow2(len(preps), 4),
+                                 raise_device_shaped=raise_device_shaped)
 
 
 class EngineOverloaded(RuntimeError):
@@ -109,7 +111,14 @@ class ServeConfig:
     max_wait_ms: float = 250.0     # max time a request waits to be batched
     max_pending: int = 256         # admitted-but-incomplete request bound
     prep_workers: int = 2          # host draft/mapping threads
-    polish_workers: int = 1        # concurrent device batches
+    polish_workers: int = 1        # concurrent device batches (devices=1)
+    # polish across a device fleet (pbccs_tpu.sched.DevicePool): N>1 uses
+    # the first N visible devices, 0 all of them, 1 (default) the legacy
+    # single-device polish executor.  Flushed buckets route STICKY by
+    # compiled-shape bucket (sched_policy), a repeatedly-failing device
+    # is benched and its batches requeue to healthy devices.
+    devices: int = 1
+    sched_policy: str = "sticky"   # sticky | least | roundrobin
     default_deadline_ms: float = 60_000.0   # per-request deadline default
     polish_margin_ms: float = 0.0  # slack reserved for the polish itself
     # the offline CLI's read-score input gate (cli.py --minReadScore),
@@ -193,6 +202,11 @@ class CcsEngine:
         self._stop_flush = False
         self._start_t = 0.0
         self._threads: list[threading.Thread] = []
+        self._pool = None   # DevicePool when config.devices != 1
+        self._complete_queue = None   # fleet-mode completion hand-off
+        self._complete_thread = None
+        self._n_polish_workers = 0   # set by start(); close() must not
+        # depend on attributes a failed start() never assigned
 
     # ------------------------------------------------------------- lifecycle
 
@@ -207,6 +221,31 @@ class CcsEngine:
         # the engine's OWN measurement window: a timing.reset() elsewhere
         # in the process (bench.py) no longer clobbers engine counters
         self._window = timing.window()
+        n_polish = self.config.polish_workers
+        if self.config.devices != 1:
+            # device-fleet mode: the DevicePool's per-device executor
+            # threads replace the single polish executor; flushed buckets
+            # route sticky by compiled-shape bucket (pbccs_tpu/sched)
+            from pbccs_tpu.sched import (DevicePool, DevicePoolConfig,
+                                         select_devices)
+
+            try:
+                devs = select_devices(self.config.devices)
+            except ValueError as e:
+                raise ValueError(f"ServeConfig.devices: {e}") from None
+            self._pool = DevicePool(
+                devs, DevicePoolConfig(policy=self.config.sched_policy),
+                logger=self._log)
+            n_polish = 0
+            # batch completions run arbitrary caller code (replies on a
+            # possibly-slow client socket, bounded only by the session's
+            # idle timeout): hand them to a dedicated thread so a stalled
+            # send blocks this thread, never a device executor
+            self._complete_queue = queue.Queue()
+            self._complete_thread = threading.Thread(
+                target=self._completion_worker, daemon=True,
+                name="ccs-serve-complete")
+            self._complete_thread.start()
         self._threads = [
             threading.Thread(target=self._prep_worker, daemon=True,
                              name=f"ccs-serve-prep-{i}")
@@ -217,15 +256,17 @@ class CcsEngine:
         ] + [
             threading.Thread(target=self._polish_worker, daemon=True,
                              name=f"ccs-serve-polish-{i}")
-            for i in range(self.config.polish_workers)
+            for i in range(n_polish)
         ]
+        self._n_polish_workers = n_polish
         self._polish_queue: queue.Queue[Batch | None] = queue.Queue()
         for t in self._threads:
             t.start()
         self._log.info(
             f"ccs engine up: max_batch={self.config.max_batch} "
             f"max_wait={self.config.max_wait_ms}ms "
-            f"max_pending={self.config.max_pending}")
+            f"max_pending={self.config.max_pending}"
+            + (f" devices={self._pool.n_devices}" if self._pool else ""))
         return self
 
     def close(self, drain: bool = True,
@@ -281,12 +322,27 @@ class CcsEngine:
             self._stop_flush = True
         with self._wake:
             self._wake.notify_all()
-        for _ in range(self.config.polish_workers):
+        for _ in range(self._n_polish_workers):
             self._polish_queue.put(None)
         for t in self._threads:
             t.join(timeout=10.0)
         with self._lock:
             aborted = self._abort
+        if self._pool is not None:
+            # draining already waited for in-flight batches; an abort
+            # fails queued pool tasks (their callbacks complete the
+            # requests with a structured error) and bounds the worker
+            # joins like the legacy polish-worker path, so a hung device
+            # program cannot hold the drain-deadline fallback hostage
+            self._pool.close(wait=not aborted,
+                             join_timeout_s=10.0 if aborted else 60.0)
+            self._pool = None
+        if self._complete_thread is not None:
+            # after pool.close() every settled future has enqueued its
+            # completion; the sentinel lands behind them all
+            self._complete_queue.put(None)
+            self._complete_thread.join(timeout=10.0)
+            self._complete_thread = None
         if aborted:
             # fail whatever is still parked anywhere
             leftovers = [i.payload[0] for b in self._batcher.drain()
@@ -423,45 +479,131 @@ class CcsEngine:
         self._log.debug(
             f"flush bucket={batch.key} n={len(batch.items)} "
             f"reason={batch.reason}")
-        self._polish_queue.put(batch)
+        if self._pool is not None:
+            # device-fleet mode: the pool picks the device (sticky by the
+            # batch's compiled-shape bucket); a device-shaped failure
+            # requeues the WHOLE batch to a healthy device before the
+            # requests see an error (pbccs_tpu/sched)
+            attempts = [0]
+
+            def run(_device, batch=batch, attempts=attempts):
+                attempts[0] += 1
+                return self._run_polish(batch,
+                                        first_attempt=attempts[0] == 1)
+
+            self._pool.submit(
+                batch.key, run, zmws=len(batch.items),
+                callback=lambda fut: self._pool_done(batch, fut))
+        else:
+            self._polish_queue.put(batch)
+
+    def _run_polish(self, batch: Batch, first_attempt: bool = False) -> list:
+        """One batch through the polish fn under the watchdog; raises on
+        failure (the caller routes the error to this batch's requests).
+        On a fleet's first attempt the default polish fn re-raises
+        device-shaped failures (persistent XLA errors) instead of
+        quarantining in place, so the pool can bench the sick device and
+        requeue the whole batch to a healthy one -- mirroring the batch
+        executor (pbccs_tpu.sched.executor)."""
+        from pbccs_tpu.resilience.watchdog import (WatchdogTimeout,
+                                                   run_with_deadline)
+
+        raise_dev = (first_attempt and self._pool is not None
+                     and self._pool.n_devices > 1
+                     and self._polish_fn is _polish_shape_pinned)
+        preps = [item.payload[1] for item in batch.items]
+        with obs_trace.span("serve.polish", bucket=str(batch.key),
+                            zmws=len(batch.items),
+                            reason=batch.reason), \
+                timing.stage("serve.polish"):
+            # the watchdog turns a hung device program into a structured
+            # timeout on THIS batch's requests; the engine keeps serving
+            try:
+                outcomes = run_with_deadline(
+                    (lambda: self._polish_fn(preps, self.settings,
+                                             raise_device_shaped=True))
+                    if raise_dev else
+                    (lambda: self._polish_fn(preps, self.settings)),
+                    self.config.polish_timeout_ms / 1e3,
+                    site="serve.polish")
+            except WatchdogTimeout as e:
+                if not first_attempt and self._pool is not None:
+                    # a SECOND expiry on a different device is workload-
+                    # shaped (the batch is just slower than the deadline,
+                    # e.g. a cold compile), not sick hardware: wrap it so
+                    # the pool fails the batch instead of striking another
+                    # healthy device and touring the whole fleet at one
+                    # full timeout per hop
+                    raise RuntimeError(
+                        f"polish timed out on two devices: {e}") from e
+                raise
+        if len(outcomes) != len(batch.items):
+            raise RuntimeError(
+                f"polish returned {len(outcomes)} outcomes for "
+                f"{len(batch.items)} requests")
+        return outcomes
+
+    def _complete_batch(self, batch: Batch, outcomes: list | None = None,
+                        error: BaseException | None = None) -> None:
+        reqs = [item.payload[0] for item in batch.items]
+        pairs: list = []
+        if error is None:
+            # validate shape BEFORE completing anything: a malformed
+            # outcome must fail the whole batch, never complete part of
+            # it and strand the rest (in pool mode this runs inside a
+            # SchedFuture callback, where an escaped exception is only
+            # debug-logged)
+            try:
+                pairs = [(failure, result) for failure, result in outcomes]
+            except Exception as e:  # noqa: BLE001
+                error = RuntimeError(f"malformed polish outcomes: {e!r}")
+        try:
+            if error is not None:
+                for req in reqs:
+                    self._complete_error(req, f"polish failed: {error!r}")
+            else:
+                for req, (failure, result) in zip(reqs, pairs):
+                    self._complete(req, failure, result)
+        finally:
+            # in-flight accounting must survive any completion error or
+            # close(drain=True) spins forever waiting on this batch
+            with self._lock:
+                self._in_flight_batches -= 1
+                self._in_flight_zmws -= len(batch.items)
+            _m_inflight_batches.dec()
+            _m_inflight_zmws.dec(len(batch.items))
+
+    def _pool_done(self, batch: Batch, fut) -> None:
+        # runs on a device executor thread: hand off immediately so the
+        # device goes back to polishing while replies hit client sockets
+        exc = fut.exception()
+        self._complete_queue.put(
+            (batch, None if exc is not None else fut.result(), exc))
+
+    def _completion_worker(self) -> None:
+        while True:
+            item = self._complete_queue.get()
+            if item is None:
+                return
+            batch, outcomes, error = item
+            try:
+                self._complete_batch(batch, outcomes, error=error)
+            except Exception as e:  # noqa: BLE001 -- the completer must
+                # outlive any one batch (accounting already ran in
+                # _complete_batch's finally)
+                self._log.warn(f"batch completion failed: {e!r}")
 
     def _polish_worker(self) -> None:
         while True:
             batch = self._polish_queue.get()
             if batch is None:
                 return
-            reqs = [item.payload[0] for item in batch.items]
-            preps = [item.payload[1] for item in batch.items]
             try:
-                from pbccs_tpu.resilience.watchdog import run_with_deadline
-
-                with obs_trace.span("serve.polish", bucket=str(batch.key),
-                                    zmws=len(batch.items),
-                                    reason=batch.reason), \
-                        timing.stage("serve.polish"):
-                    # the watchdog turns a hung device program into a
-                    # structured timeout on THIS batch's requests; the
-                    # engine (and its polish worker) keep serving
-                    outcomes = run_with_deadline(
-                        lambda: self._polish_fn(preps, self.settings),
-                        self.config.polish_timeout_ms / 1e3,
-                        site="serve.polish")
-                if len(outcomes) != len(reqs):
-                    raise RuntimeError(
-                        f"polish returned {len(outcomes)} outcomes for "
-                        f"{len(reqs)} requests")
+                outcomes = self._run_polish(batch)
             except Exception as e:  # noqa: BLE001 -- fail THIS batch only
-                for req in reqs:
-                    self._complete_error(req, f"polish failed: {e!r}")
+                self._complete_batch(batch, error=e)
             else:
-                for req, (failure, result) in zip(reqs, outcomes):
-                    self._complete(req, failure, result)
-            finally:
-                with self._lock:
-                    self._in_flight_batches -= 1
-                    self._in_flight_zmws -= len(batch.items)
-                _m_inflight_batches.dec()
-                _m_inflight_zmws.dec(len(batch.items))
+                self._complete_batch(batch, outcomes)
 
     # ------------------------------------------------------------ completion
 
@@ -514,8 +656,11 @@ class CcsEngine:
             )
         stage_s = {k: round(v, 4)
                    for k, v in timing.stage_seconds(self._window).items()}
+        pool = self._pool   # close() may null the attribute concurrently
+        sched = {"sched": pool.status()} if pool is not None else {}
         return {
             "engine": "ccs-serve",
+            **sched,
             "uptime_s": round(time.monotonic() - self._start_t, 3),
             "queue_depth": max(0, snap["pending"] - snap["in_flight_zmws"]),
             "bucketed": self._batcher.pending_count(),
@@ -545,7 +690,7 @@ class CcsEngine:
             if kind == "histogram" or not name.startswith(
                     ("ccs_serve_", "ccs_batch_", "ccs_device_",
                      "ccs_retries_", "ccs_quarantine", "ccs_degraded_",
-                     "ccs_watchdog_", "ccs_faults_")):
+                     "ccs_watchdog_", "ccs_faults_", "ccs_sched_")):
                 continue
             suffix = "{%s}" % ",".join(
                 f"{k}={v}" for k, v in labels) if labels else ""

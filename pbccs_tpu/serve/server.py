@@ -414,6 +414,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "Default = %(default)s")
     p.add_argument("--prepWorkers", type=int, default=defaults.prep_workers,
                    help="Host draft/mapping threads. Default = %(default)s")
+    p.add_argument("--devices", type=int, default=defaults.devices,
+                   help="Polish across a device fleet (pbccs_tpu.sched): "
+                        "N>1 uses the first N visible devices, 0 all of "
+                        "them, 1 the legacy single-device polish "
+                        "executor. Default = %(default)s")
+    p.add_argument("--schedPolicy",
+                   choices=("sticky", "least", "roundrobin"),
+                   default=defaults.sched_policy,
+                   help="Device-fleet routing: sticky keeps a compiled-"
+                        "shape bucket on the device that already compiled "
+                        "it (least-loaded otherwise). "
+                        "Default = %(default)s")
     p.add_argument("--deadlineMs", type=float,
                    default=defaults.default_deadline_ms,
                    help="Default per-request deadline. Default = %(default)s")
@@ -452,6 +464,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
 def run_serve(argv: list[str] | None = None) -> int:
     """`ccs serve` entry point (dispatched from pbccs_tpu.cli)."""
     args = build_serve_parser().parse_args(argv)
+    if args.devices < 0:
+        print(f"option --devices: must be >= 0, got {args.devices}",
+              file=sys.stderr)
+        return 2
 
     from pbccs_tpu.resilience import faults
 
@@ -471,6 +487,8 @@ def run_serve(argv: list[str] | None = None) -> int:
         max_wait_ms=args.maxWaitMs,
         max_pending=args.maxPending,
         prep_workers=args.prepWorkers,
+        devices=args.devices,
+        sched_policy=args.schedPolicy,
         default_deadline_ms=args.deadlineMs,
         min_read_score=args.minReadScore,
         polish_timeout_ms=(args.polishTimeout or 0) * 1e3,

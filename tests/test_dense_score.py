@@ -247,6 +247,123 @@ def test_edge_window_scores_match_oracle(rng, windows):
                     err_msg=f"read {r} row {row} p={p} k={k} J={J}")
 
 
+def test_band_read_windows_flat_offset_garbage_lane(rng):
+    """Consumer-gating invariant of band_read_windows' derived rbase
+    (ops/dense_score_pallas.py:409): when o_j == o_{j-1} (flat offsets
+    are routine -- clamped band starts/ends, and EVERY column of a read
+    no longer than W) the cut-lane derivation returns rf[o_j + W - 1]
+    instead of rf[o_j - 1], a garbage value every consumer must gate.
+
+    Pinned two ways on a constructed all-flat read (I == W => offsets
+    identically 0) plus two normal reads (flat runs at the clamps):
+
+      * windows-fed vs DIRECT-window form: scores from the derived
+        (rbase, rnext) equal scores from an explicitly built
+        rbase_direct[j][L] = read_pad0[rows_j[L] - 1] (one extra
+        window_rows_circ over the shifted read), bitwise, on every
+        consumed slot of both the interior kernel and the edge programs;
+      * poison probe: overwriting exactly the flat-offset cut lanes with
+        an out-of-alphabet value changes no consumed score.
+
+    Any new consumer of rbase that drops the in_band/cmask gates breaks
+    this test."""
+    from pbccs_tpu.ops.fwdbwd_pallas import window_rows_circ
+
+    # read 2's window is 8 long => its clipped read has I = 16 = W, so
+    # its band cannot advance: o_j == o_{j-1} at (essentially) every
+    # column -- the all-flat extreme of the garbage-lane premise
+    windows = [(0, 0, 60), (1, 0, 60), (0, 0, 8)]
+    case = _setup_case(rng, 60, 2, windows)
+    R = case["reads"].shape[0]
+    offs = np.asarray(case["alpha"].offsets)
+    flat = np.zeros_like(offs, bool)
+    flat[:, 1:] = offs[:, 1:] == offs[:, :-1]
+    assert flat[2, 1:].sum() >= flat[2, 1:].size - 2, \
+        "constructed read must have (essentially) all-flat offsets"
+    assert flat[0].any() and flat[1].any(), \
+        "normal reads should flat-line at the band clamps"
+
+    rwin = dsp.band_read_windows(case["reads"], case["alpha"].offsets, W)
+    rbase, rnext = (np.asarray(a) for a in rwin)
+
+    # direct-window form: one more MXU windowing over the 1-shifted read
+    # (read_pad0[row - 1]; row 0 reads the pad base, which is gated)
+    read_f = np.asarray(case["reads"]).astype(np.float32)
+    shifted = np.concatenate(
+        [np.full((R, 1), 4.0, np.float32), read_f[:, :-1]], axis=1)
+    rbase_direct = np.asarray(jax.vmap(
+        lambda r, o: window_rows_circ(r, o, W)
+    )(jnp.asarray(shifted), case["alpha"].offsets))
+    # the premise: the two forms genuinely DISAGREE on the garbage lanes
+    assert not np.array_equal(rbase, rbase_direct)
+
+    # poison probe: exactly the flat-offset cut lanes
+    lane = offs % W
+    poison = rbase.copy()
+    rr, jj = np.nonzero(flat)
+    poison[rr, jj, lane[rr, jj]] = 9.0
+    assert not np.array_equal(poison, rbase)
+
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    ptrans = jax.vmap(dsp.dense_patch_grids)(
+        case["win_tpl"].astype(jnp.int32), case["win_trans"], tables,
+        case["wlens"])
+
+    def interior(rb):
+        return np.asarray(dsp.dense_interior_scores_batch(
+            case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], tables, case["alpha"],
+            case["beta"], case["apre"], case["bsuf"], W,
+            rwin=(jnp.asarray(rb), jnp.asarray(rnext))))
+
+    def edges(rb):
+        return np.asarray(dsp.edge_window_scores_batch(
+            case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], case["alpha"], case["beta"],
+            case["apre"], case["bsuf"], ptrans, W,
+            rwin=(jnp.asarray(rb), jnp.asarray(rnext))))
+
+    int_ref, edge_ref = interior(rbase), edges(rbase)
+    checked = 0
+    for variant, (int_v, edge_v) in {
+            "direct": (interior(rbase_direct), edges(rbase_direct)),
+            "poison": (interior(poison), edges(poison))}.items():
+        for r in range(R):
+            # interior consumers: compare on the batch scorer's actual
+            # interior classification, in template frame
+            start, end, mtype, base, valid = dr.slot_candidates(
+                case["tpl_p"].astype(jnp.int8), case["tlen"])
+            mask = _interior_mask(case, r, start, end, mtype, valid)
+            m_ref = np.asarray(dsp.window_grid_to_template(
+                jnp.asarray(int_ref[r]), case["strands"][r], case["ts"][r],
+                case["te"][r], case["Jmax"])).reshape(-1)
+            m_v = np.asarray(dsp.window_grid_to_template(
+                jnp.asarray(int_v[r]), case["strands"][r], case["ts"][r],
+                case["te"][r], case["Jmax"])).reshape(-1)
+            np.testing.assert_array_equal(
+                m_v[mask], m_ref[mask],
+                err_msg=f"{variant}: interior scores moved, read {r}")
+            checked += int(mask.sum())
+            # edge consumers: the served (row, slot) grid entries
+            J = int(case["wlens"][r])
+            for row, p in enumerate([0, 1, 2, J - 2, J - 1, J]):
+                for k in range(9):
+                    mt = [0, 0, 0, 0, 1, 1, 1, 1, 2][k]
+                    if mt == 1:
+                        if p > J or row == 3:
+                            continue
+                    elif p >= J:
+                        continue
+                    if p <= 2 and row >= 3:
+                        continue
+                    np.testing.assert_array_equal(
+                        edge_v[r, row, k], edge_ref[r, row, k],
+                        err_msg=f"{variant}: edge score moved, read {r} "
+                                f"row {row} k {k}")
+                    checked += 1
+    assert checked > 400, "test exercised too few consumed slots"
+
+
 def test_dense_patch_grids_match_make_patches(rng):
     """Window-frame patch planes equal make_patches_fast on the grid."""
     tpl, _, _, snr = simulate_zmw(rng, 50, 3)

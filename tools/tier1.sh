@@ -19,6 +19,9 @@ echo "== fuzz smoke (hostile-input hardening: BAM salvage / wire armor / drain) 
 # deterministic: any finding reproduces with --seed 0 --only <CLASS>
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --smoke --seed 0 || exit 1
 
+echo "== sched smoke (device-fleet scheduler: 8-device scaling + benched-device chaos) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/sched_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
