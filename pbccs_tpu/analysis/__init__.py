@@ -1,0 +1,51 @@
+"""`ccs analyze`: project-native static analysis.
+
+Three AST-based passes over the repository -- concurrency lint (lock
+discipline, blocking-under-lock, lock-order cycles), JAX/Pallas
+tracer hygiene, and cross-file registry drift (metrics/fault sites vs
+docs/DESIGN.md, CLI flags vs README, exception policy) -- plus a
+committed-baseline ratchet.  See docs/DESIGN.md "Static analysis" for
+the rule catalogue and pbccs_tpu/analysis/core.py for how to add a
+rule.  Entry points: `ccs analyze` (pbccs_tpu.analysis.cli) and
+tools/analyze_smoke.py (the tier-1 gate).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from pbccs_tpu.analysis.core import (  # noqa: F401 -- public API
+    RULES,
+    Finding,
+    SourceFile,
+    apply_inline_suppressions,
+    iter_code_files,
+    load_sources,
+)
+
+
+def run_passes(root: pathlib.Path,
+               paths: list[pathlib.Path] | None = None,
+               rules: set[str] | None = None) -> list["Finding"]:
+    """Run every analyzer over `root` (or just `paths`), returning
+    findings with inline suppressions already applied (baseline
+    filtering is the CLI's job).  `rules` filters to a subset of ids."""
+    from pbccs_tpu.analysis.conc import analyze_conc
+    from pbccs_tpu.analysis.jaxlint import analyze_jax
+    from pbccs_tpu.analysis.registry import (
+        analyze_exceptions,
+        analyze_registry,
+    )
+
+    sources, findings = load_sources(root, paths)
+    findings += analyze_conc(sources)
+    findings += analyze_jax(sources)
+    findings += analyze_exceptions(sources)
+    if paths is None:
+        # drift checks read the whole repo + docs; path-scoped runs
+        # (tests over fixtures) skip them
+        findings += analyze_registry(sources, root)
+    findings = apply_inline_suppressions(findings, sources)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

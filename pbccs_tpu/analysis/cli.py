@@ -1,0 +1,184 @@
+"""`ccs analyze` -- the CLI front end of pbccs_tpu.analysis.
+
+    python -m pbccs_tpu.cli analyze [--root DIR] [--format text|json]
+    python -m pbccs_tpu.analysis.cli --emit-tables   # regen DESIGN tables
+
+Exit 0 when the repo is clean modulo the committed baseline
+(analysis/baseline.toml); exit 1 on any unsuppressed finding, including
+stale baseline entries (ANA001).  The run is pure AST -- no imports of
+the analyzed code, no jax -- so it finishes in seconds and is safe as a
+tier-1 CI step (tools/tier1.sh reports its runtime).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import pathlib
+import sys
+import time
+
+from pbccs_tpu.analysis import RULES, run_passes
+from pbccs_tpu.analysis.baseline import (
+    BaselineError,
+    apply_baseline,
+    load_baseline,
+)
+
+DEFAULT_BASELINE = "pbccs_tpu/analysis/baseline.toml"
+
+
+def _find_root(start: pathlib.Path) -> pathlib.Path:
+    """Nearest ancestor that looks like the repo root (has pbccs_tpu/)."""
+    for p in (start, *start.parents):
+        if (p / "pbccs_tpu").is_dir():
+            return p
+    return start
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ccs analyze",
+        description="Project-native static analysis: concurrency lint, "
+                    "JAX tracer hygiene, registry drift.")
+    p.add_argument("--root", default=None,
+                   help="Repository root to analyze (default: nearest "
+                        "ancestor of CWD containing pbccs_tpu/).")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"Suppression file (default: {DEFAULT_BASELINE} "
+                        "under the root).")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="Report raw findings, ignoring the baseline.")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="Output format. Default = %(default)s")
+    p.add_argument("--rules", default=None, metavar="IDS",
+                   help="Comma-separated rule ids to run (default: all).")
+    p.add_argument("--list-rules", action="store_true",
+                   help="Print the rule catalogue and exit.")
+    p.add_argument("--emit-tables", action="store_true",
+                   help="Print regenerated DESIGN.md metrics/fault-site "
+                        "tables and exit (paste between the "
+                        "ccs-analyze markers).")
+    p.add_argument("paths", nargs="*",
+                   help="Specific files to analyze (default: the whole "
+                        "repo).  Path-scoped runs skip the repo-wide "
+                        "drift checks (REG*).")
+    return p
+
+
+def _mute_stdout() -> None:
+    """Point stdout at /dev/null after a BrokenPipeError so the
+    interpreter-exit flush does not raise again."""
+    with contextlib.suppress(Exception):
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+def run_analyze(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except BrokenPipeError:
+        # only the informational modes (--list-rules/--emit-tables) can
+        # reach here: finding-bearing runs settle their verdict before
+        # printing (see _run), so `... | head` cannot flip them to clean
+        _mute_stdout()
+        return 0
+
+
+def _run(args) -> int:
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    root = pathlib.Path(args.root).resolve() if args.root \
+        else _find_root(pathlib.Path.cwd())
+    t0 = time.perf_counter()
+
+    if args.emit_tables:
+        from pbccs_tpu.analysis.core import load_sources
+        from pbccs_tpu.analysis.registry import (
+            collect_fault_sites,
+            collect_metrics,
+            render_metrics_table,
+            render_sites_table,
+        )
+
+        sources, _ = load_sources(root)
+        pkg = [s for s in sources if s.rel.startswith("pbccs_tpu/")]
+        print(render_metrics_table(collect_metrics(pkg)))
+        print()
+        print(render_sites_table(collect_fault_sites(pkg)))
+        return 0
+
+    rules = ({r.strip() for r in args.rules.split(",") if r.strip()}
+             if args.rules else None)
+    paths = None
+    if args.paths:
+        paths = []
+        for raw in args.paths:
+            p = pathlib.Path(raw).resolve()
+            try:
+                p.relative_to(root)
+            except ValueError:
+                print(f"ccs analyze: {raw} is outside --root {root}",
+                      file=sys.stderr)
+                return 2
+            paths.append(p)
+    findings = run_passes(root, paths=paths, rules=rules)
+
+    n_suppressed = 0
+    if not args.no_baseline:
+        baseline_path = (pathlib.Path(args.baseline) if args.baseline
+                         else root / DEFAULT_BASELINE)
+        try:
+            suppressions = load_baseline(baseline_path)
+        except BaselineError as e:
+            print(f"ccs analyze: bad baseline: {e}", file=sys.stderr)
+            return 2
+        # a scoped run (rules subset / explicit paths) must not declare
+        # out-of-scope suppressions stale: only entries the run could
+        # have matched participate
+        if rules is not None:
+            suppressions = [s for s in suppressions if s.rule in rules]
+        if paths is not None:
+            scoped = {p.relative_to(root).as_posix() for p in paths}
+            suppressions = [s for s in suppressions if s.path in scoped]
+        rel = baseline_path.as_posix()
+        if baseline_path.is_absolute():
+            try:
+                rel = baseline_path.relative_to(root).as_posix()
+            except ValueError:
+                pass
+        findings, n_suppressed = apply_baseline(findings, suppressions, rel)
+
+    dt = time.perf_counter() - t0
+    rc = 1 if findings else 0
+    try:
+        if args.format == "json":
+            print(json.dumps({
+                "findings": [f.to_json() for f in findings],
+                "suppressed": n_suppressed,
+                "elapsed_s": round(dt, 3),
+            }, indent=2))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"ccs analyze: {len(findings)} finding(s), "
+                  f"{n_suppressed} suppressed by baseline, "
+                  f"{dt:.2f}s", file=sys.stderr)
+    except BrokenPipeError:
+        # the consumer closed the pipe (`ccs analyze | head`): truncated
+        # OUTPUT must not change the verdict
+        _mute_stdout()
+    return rc
+
+
+def main() -> None:
+    sys.exit(run_analyze())
+
+
+if __name__ == "__main__":
+    main()

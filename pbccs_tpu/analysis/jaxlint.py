@@ -1,0 +1,414 @@
+"""JAX/Pallas tracer-hygiene lint.
+
+Inside functions reachable from `jax.jit` / `pl.pallas_call` /
+`shard_map`, Python control flow and host conversions on traced values
+are either trace-time errors or silent performance cliffs (a fresh
+compile per call).  This pass finds them statically -- the bucket-menu
+discipline the warmup path relies on, checked before the device ever
+sees the program.
+
+Reachability: a function is jit-reachable when it is decorated with
+`@jax.jit` / `@functools.partial(jax.jit, ...)`, passed callable-first
+to `jax.jit(f)` / `pl.pallas_call(f, ...)` / `shard_map(f, ...)`, or
+called (by name, same module) from a reachable function.  Parameters
+named in `static_argnames` / positioned in `static_argnums` are static
+and never tainted.
+
+Taint: parameters of directly-jitted functions (minus static ones) and
+any value produced by a `jnp.*` / `lax.*` / `jax.*` call, propagated
+through assignments and arithmetic.  Shape metadata (`x.shape`,
+`x.ndim`, `x.dtype`, `x.size`, `len(x)`) is static under trace and
+un-taints.  `x is None` / `x is not None` comparisons are identity
+checks on the tracer object -- static, allowed.
+
+  JAX001  `if`/`while` on a tainted expression (needs lax.cond /
+          lax.while_loop / jnp.where)
+  JAX002  host sync on a tainted value: float()/int()/bool(),
+          np.asarray/np.array, .item()/.tolist()/.block_until_ready()
+  JAX003  f-string or str() over a tainted value (forces a host sync to
+          format, or formats the abstract tracer)
+  JAX004  jax.jit(<lambda or local def>) built inside a function body:
+          every evaluation mints a fresh jit wrapper with an empty
+          compile cache.  Exempt when the enclosing factory is memoized
+          (functools.lru_cache/cache decorator).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pbccs_tpu.analysis.core import Finding, SourceFile, dotted_name
+
+_TRACED_MODULES = {"jnp", "lax", "jsp", "jax"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_SYNC = {"asarray", "array", "float32", "float64", "int32", "int64"}
+_JIT_WRAPPERS = {"jit", "pallas_call", "shard_map"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """`jax.jit` / `jit` as a bare expression (decorator or callee)."""
+    d = dotted_name(node)
+    return d is not None and d[-1] == "jit" and (
+        len(d) == 1 or d[0] in ("jax", "jx"))
+
+
+def _static_params(dec_or_call: ast.Call) -> tuple[set[str], set[int]]:
+    """static_argnames / static_argnums out of a jit(...) call node."""
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in dec_or_call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+        elif kw.arg == "static_argnums":
+            vals = (kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+    return names, nums
+
+
+def _jit_decoration(fn: ast.FunctionDef
+                    ) -> tuple[bool, set[str], set[int]]:
+    """(is directly jitted, static names, static nums) from decorators."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True, set(), set()
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func)
+            if d is not None and d[-1] == "partial" and dec.args \
+                    and _is_jit_expr(dec.args[0]):
+                names, nums = _static_params(dec)
+                return True, names, nums
+            if _is_jit_expr(dec.func):
+                names, nums = _static_params(dec)
+                return True, names, nums
+    return False, set(), set()
+
+
+def _collect_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """Module-level functions by name (methods excluded: jit code in this
+    repo lives in free functions; methods go through them)."""
+    return {n.name: n for n in tree.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _wrapper_seeds(tree: ast.Module, funcs: dict[str, ast.FunctionDef]
+                   ) -> set[str]:
+    """Functions passed callable-first to jit/pallas_call/shard_map."""
+    seeds: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        d = dotted_name(node.func)
+        if d is None or d[-1] not in _JIT_WRAPPERS:
+            continue
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Name) and arg0.id in funcs:
+            seeds.add(arg0.id)
+        elif isinstance(arg0, ast.Call):
+            # shard_map(partial(f, ...)) / jit(shard_map(f, ...))
+            inner = dotted_name(arg0.func)
+            if inner is not None and arg0.args \
+                    and isinstance(arg0.args[0], ast.Name) \
+                    and arg0.args[0].id in funcs:
+                seeds.add(arg0.args[0].id)
+    return seeds
+
+
+def _reachable(funcs: dict[str, ast.FunctionDef], seeds: set[str]
+               ) -> set[str]:
+    out = set()
+    frontier = [s for s in seeds if s in funcs]
+    while frontier:
+        name = frontier.pop()
+        if name in out:
+            continue
+        out.add(name)
+        for node in ast.walk(funcs[name]):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in funcs and node.func.id not in out:
+                frontier.append(node.func.id)
+    return out
+
+
+class _TaintChecker:
+    """Single forward pass (run twice for loop-carried taint) over one
+    reachable function."""
+
+    def __init__(self, src: SourceFile, fn: ast.FunctionDef,
+                 seed_params: bool, static_names: set[str],
+                 static_nums: set[int], findings: list[Finding]):
+        self.src = src
+        self.fn = fn
+        self.findings = findings
+        self.tainted: set[str] = set()
+        self.reported: set[tuple[str, int]] = set()
+        if seed_params:
+            params = fn.args.posonlyargs + fn.args.args
+            for i, a in enumerate(params):
+                if a.arg in static_names or i in static_nums \
+                        or a.arg == "self":
+                    continue
+                self.tainted.add(a.arg)
+            for a in fn.args.kwonlyargs:
+                if a.arg not in static_names:
+                    self.tainted.add(a.arg)
+
+    # --------------------------------------------------------- expression
+
+    def expr_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False          # static under trace
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None:
+                if d[0] in _TRACED_MODULES:
+                    return True
+                if d[-1] == "len":
+                    return False      # len(tracer) is static
+                if d[-1] in _SHAPE_ATTRS:
+                    return False
+            if isinstance(node.func, ast.Attribute) \
+                    and self.expr_tainted(node.func.value):
+                return True           # method call on a traced value
+            return any(self.expr_tainted(a) for a in node.args) or any(
+                self.expr_tainted(k.value) for k in node.keywords)
+        if isinstance(node, ast.Compare):
+            # `x is None` identity checks are static even on tracers
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.expr_tainted(node.left) or any(
+                self.expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) or \
+                self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.expr_tainted(node.body) or \
+                self.expr_tainted(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        return False
+
+    def _taint_target(self, target: ast.expr, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_target(e, tainted)
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        key = (rule, node.lineno)
+        if key not in self.reported:
+            self.reported.add(key)
+            self.findings.append(
+                Finding(rule, self.src.rel, node.lineno, msg))
+
+    # ---------------------------------------------------------- statements
+
+    def run(self) -> None:
+        for _ in range(2):           # second pass catches loop-carried taint
+            for stmt in self.fn.body:
+                self.visit(stmt)
+
+    def visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs inherit the enclosing taint environment, but
+            # their parameters are fresh bindings that shadow outer names
+            params = {a.arg for a in (node.args.posonlyargs
+                                      + node.args.args
+                                      + node.args.kwonlyargs)}
+            saved = set(self.tainted)
+            self.tainted -= params
+            for stmt in node.body:
+                self.visit(stmt)
+            self.tainted = saved | (self.tainted - params)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            if value is not None:
+                self.check_expr(value)
+                t = self.expr_tainted(value)
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if isinstance(node, ast.AugAssign):
+                    t = t or self.expr_tainted(node.target)
+                for tgt in targets:
+                    self._taint_target(tgt, t)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self.check_expr(node.test)
+            if self.expr_tainted(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._flag(
+                    "JAX001", node,
+                    f"Python `{kind}` on a traced value inside a "
+                    "jit-reachable function (use lax.cond/lax.while_loop/"
+                    "jnp.where)")
+            for stmt in node.body + node.orelse:
+                self.visit(stmt)
+            return
+        if isinstance(node, ast.For):
+            self.check_expr(node.iter)
+            self._taint_target(node.target, self.expr_tainted(node.iter))
+            for stmt in node.body + node.orelse:
+                self.visit(stmt)
+            return
+        if isinstance(node, ast.Return) and node.value is not None:
+            self.check_expr(node.value)
+            return
+        if isinstance(node, ast.Expr):
+            self.check_expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.visit(child)
+            elif isinstance(child, ast.expr):
+                self.check_expr(child)
+            elif isinstance(child, (ast.ExceptHandler, ast.withitem,
+                                    ast.match_case)):
+                # containers that are neither stmt nor expr: recurse, or
+                # `except:` bodies and `with` context expressions would be
+                # silently unchecked
+                self.visit(child)
+
+    def check_expr(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+            elif isinstance(sub, ast.JoinedStr):
+                for part in sub.values:
+                    if isinstance(part, ast.FormattedValue) \
+                            and self.expr_tainted(part.value):
+                        self._flag(
+                            "JAX003", sub,
+                            "f-string formats a traced value inside a "
+                            "jit-reachable function (forces a host sync "
+                            "or formats the abstract tracer)")
+
+    def _check_call(self, call: ast.Call) -> None:
+        # .item()/.tolist()/... also on non-name receivers (x.sum().item())
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _SYNC_METHODS \
+                and self.expr_tainted(call.func.value):
+            self._flag(
+                "JAX002", call,
+                f".{call.func.attr}() on a traced value inside a "
+                "jit-reachable function is a host sync")
+            return
+        d = dotted_name(call.func)
+        if d is None:
+            return
+        args_tainted = any(self.expr_tainted(a) for a in call.args)
+        if len(d) == 1 and d[0] in ("float", "int", "bool", "complex") \
+                and args_tainted:
+            self._flag(
+                "JAX002", call,
+                f"{d[0]}() on a traced value inside a jit-reachable "
+                "function is a host sync (trace-time ConcretizationError)")
+        elif len(d) == 1 and d[0] == "str" and args_tainted:
+            self._flag(
+                "JAX003", call,
+                "str() on a traced value inside a jit-reachable function")
+        elif len(d) == 2 and d[0] in ("np", "numpy") \
+                and d[1] in _NP_SYNC and args_tainted:
+            self._flag(
+                "JAX002", call,
+                f"np.{d[1]}() on a traced value inside a jit-reachable "
+                "function forces a device-to-host transfer")
+
+
+def _is_memoized(fn: ast.FunctionDef) -> bool:
+    return any(
+        (dotted_name(d) or ("",))[-1] in ("lru_cache", "cache")
+        or (isinstance(d, ast.Call)
+            and (dotted_name(d.func) or ("",))[-1]
+            in ("lru_cache", "cache"))
+        for d in fn.decorator_list)
+
+
+class _JitFactoryWalker(ast.NodeVisitor):
+    """JAX004: jax.jit(<lambda/local def>) attributed to its NEAREST
+    enclosing function; exempt when ANY function on the enclosing stack
+    is memoized (an lru_cache'd factory builds each wrapper once per
+    key, whether the jit call sits in it directly or in a helper)."""
+
+    def __init__(self, src: SourceFile, findings: list[Finding]):
+        self.src = src
+        self.findings = findings
+        # (fn node, memoized, names of defs local to that fn)
+        self.stack: list[tuple[ast.FunctionDef, bool, set[str]]] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 (ast API)
+        if self.stack:
+            self.stack[-1][2].add(node.name)
+        self.stack.append((node, _is_memoized(node), set()))
+        # decorators evaluate in the ENCLOSING scope; only the body (and
+        # default exprs) runs per call
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):  # noqa: N802
+        if (self.stack and node.args and _is_jit_expr(node.func)
+                and not any(memo for _, memo, _ in self.stack)):
+            arg0 = node.args[0]
+            local = isinstance(arg0, ast.Name) and any(
+                arg0.id in defs for _, _, defs in self.stack)
+            if isinstance(arg0, ast.Lambda) or local:
+                self.findings.append(Finding(
+                    "JAX004", self.src.rel, node.lineno,
+                    "jax.jit of a lambda/locally-defined function inside "
+                    f"{self.stack[-1][0].name}() creates a fresh compile "
+                    "cache per call (hoist to module level or memoize "
+                    "the factory)"))
+        self.generic_visit(node)
+
+
+def _check_jit_factories(src: SourceFile,
+                         findings: list[Finding]) -> None:
+    _JitFactoryWalker(src, findings).visit(src.tree)
+
+
+def analyze_jax(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        funcs = _collect_functions(src.tree)
+        direct: dict[str, tuple[set[str], set[int]]] = {}
+        for name, fn in funcs.items():
+            jitted, names, nums = _jit_decoration(fn)
+            if jitted:
+                direct[name] = (names, nums)
+        seeds = set(direct) | _wrapper_seeds(src.tree, funcs)
+        for name in sorted(_reachable(funcs, seeds)):
+            names, nums = direct.get(name, (set(), set()))
+            _TaintChecker(src, funcs[name], seed_params=name in direct,
+                          static_names=names, static_nums=nums,
+                          findings=findings).run()
+        _check_jit_factories(src, findings)
+    return findings

@@ -1,0 +1,287 @@
+"""Cross-file drift checks: metrics <-> DESIGN.md, fault sites <->
+DESIGN.md, CLI flags <-> README/DESIGN, and the exception policy.
+
+The observability layer's metric names and the resilience layer's fault
+sites are API: bench tooling, dashboards, and chaos specs key on them.
+PRs 3-5 each shipped at least one name that drifted from the docs and
+was caught by hand in review; this pass does that mechanically.
+
+The canonical inventories live in docs/DESIGN.md between marker
+comments (invisible when rendered):
+
+    <!-- ccs-analyze:metrics-table:begin -->    |`ccs_...`| ... rows
+    <!-- ccs-analyze:metrics-table:end -->
+    <!-- ccs-analyze:fault-sites-table:begin -->  |`site.name`| ... rows
+    <!-- ccs-analyze:fault-sites-table:end -->
+
+`python -m pbccs_tpu.analysis.cli --emit-tables` regenerates both
+tables from the code scan, so fixing REG001/REG003 drift is mechanical.
+
+  REG001  code registers a metric the table does not list (or the kind
+          disagrees)
+  REG002  the table lists a metric no code registers
+  REG003  code marks a fault site the table does not list
+  REG004  the table lists a fault site no code marks
+  REG005  README.md / docs/DESIGN.md references a `--flag` no argument
+          parser defines
+  EXC001  bare `except:`
+  EXC002  `except Exception/BaseException: pass` with no stated reason
+          (a `# noqa`/`# ccs-analyze` comment on the except line counts
+          as a reason; better: narrow the type or log)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+from pbccs_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    const_str_arg,
+    dotted_name,
+    module_str_constants,
+)
+
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+_NON_LABEL_KWARGS = {"help", "buckets"}
+_FLAG_RE = re.compile(r"(?<![\w\[-])--[A-Za-z][A-Za-z0-9_-]*")
+_TABLE_NAME_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|([^|]*)\|")
+
+
+@dataclasses.dataclass
+class MetricDef:
+    name: str
+    kind: str
+    labels: tuple[str, ...]
+    help: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class SiteDef:
+    name: str
+    kind: str            # "fail" (maybe_fail) | "corrupt"
+    path: str
+    line: int
+
+
+def collect_metrics(sources: list[SourceFile]) -> list[MetricDef]:
+    out: dict[tuple[str, str], MetricDef] = {}
+    for src in sources:
+        consts = module_str_constants(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = dotted_name(node.func)
+            if d is None or d[-1] not in _METRIC_KINDS:
+                continue
+            name = const_str_arg(node.args[0], consts)
+            if name is None or not name.startswith("ccs_"):
+                continue
+            labels = tuple(sorted(
+                kw.arg for kw in node.keywords
+                if kw.arg and kw.arg not in _NON_LABEL_KWARGS))
+            help_s = ""
+            if len(node.args) > 1:
+                help_s = const_str_arg(node.args[1], consts) or ""
+            key = (name, d[-1])
+            if key not in out:
+                out[key] = MetricDef(name, d[-1], labels, help_s,
+                                     src.rel, node.lineno)
+            elif labels and not out[key].labels:
+                out[key] = dataclasses.replace(out[key], labels=labels)
+    return sorted(out.values(), key=lambda m: m.name)
+
+
+def collect_fault_sites(sources: list[SourceFile]) -> list[SiteDef]:
+    out: dict[str, SiteDef] = {}
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = dotted_name(node.func)
+            if d is None or d[-1] not in ("maybe_fail", "corrupt"):
+                continue
+            # faults.corrupt(site, data) vs e.g. bytes corruption helpers:
+            # require a dotted `faults.` receiver or a bare name import
+            if len(d) > 1 and d[-2] not in ("faults", "self"):
+                continue
+            name = const_str_arg(node.args[0], {})
+            if name is None or "." not in name:
+                continue
+            kind = "corrupt" if d[-1] == "corrupt" else "fail"
+            out.setdefault(name, SiteDef(name, kind, src.rel, node.lineno))
+    return sorted(out.values(), key=lambda s: s.name)
+
+
+# -------------------------------------------------------- DESIGN.md tables
+
+def _table_entries(doc_text: str, marker: str) -> dict[str, tuple[str, int]]:
+    """{name: (second column, lineno)} for rows between the markers."""
+    out: dict[str, tuple[str, int]] = {}
+    inside = False
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        if f"ccs-analyze:{marker}:begin" in line:
+            inside = True
+            continue
+        if f"ccs-analyze:{marker}:end" in line:
+            inside = False
+            continue
+        if inside:
+            m = _TABLE_NAME_RE.match(line.strip())
+            if m and not m.group(1).startswith("-"):
+                out[m.group(1)] = (m.group(2).strip(), i)
+    return out
+
+
+def render_metrics_table(metrics: list[MetricDef]) -> str:
+    lines = ["| metric | kind | labels | source |",
+             "|---|---|---|---|"]
+    for m in metrics:
+        labels = ", ".join(f"`{la}`" for la in m.labels) or "—"
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | `{m.path}` |")
+    return "\n".join(lines)
+
+
+def render_sites_table(sites: list[SiteDef]) -> str:
+    lines = ["| fault site | marker | source |",
+             "|---|---|---|"]
+    for s in sites:
+        marker = "corrupt()" if s.kind == "corrupt" else "maybe_fail()"
+        lines.append(f"| `{s.name}` | {marker} | `{s.path}` |")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- the pass
+
+def analyze_registry(sources: list[SourceFile],
+                     root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    design_path = root / "docs" / "DESIGN.md"
+    design_rel = "docs/DESIGN.md"
+    design = design_path.read_text() if design_path.exists() else ""
+
+    pkg_sources = [s for s in sources if s.rel.startswith("pbccs_tpu/")]
+    metrics = collect_metrics(pkg_sources)
+    sites = collect_fault_sites(pkg_sources)
+
+    doc_metrics = _table_entries(design, "metrics-table")
+    doc_sites = _table_entries(design, "fault-sites-table")
+
+    if not design:
+        findings.append(Finding("REG002", design_rel, 1,
+                                "docs/DESIGN.md is missing"))
+        return findings
+
+    for m in metrics:
+        entry = doc_metrics.get(m.name)
+        if entry is None:
+            findings.append(Finding(
+                "REG001", m.path, m.line,
+                f"metric `{m.name}` ({m.kind}) is not in the DESIGN.md "
+                "metrics table (run `python -m pbccs_tpu.analysis.cli "
+                "--emit-tables` to regenerate)"))
+        elif entry[0] and entry[0] != m.kind:
+            findings.append(Finding(
+                "REG001", m.path, m.line,
+                f"metric `{m.name}` is a {m.kind} in code but listed as "
+                f"`{entry[0]}` in the DESIGN.md metrics table"))
+    code_metric_names = {m.name for m in metrics}
+    for name, (_, lineno) in sorted(doc_metrics.items()):
+        if name not in code_metric_names:
+            findings.append(Finding(
+                "REG002", design_rel, lineno,
+                f"DESIGN.md metrics table lists `{name}` but no code "
+                "registers it"))
+
+    code_site_names = {s.name for s in sites}
+    for s in sites:
+        if s.name not in doc_sites:
+            findings.append(Finding(
+                "REG003", s.path, s.line,
+                f"fault site `{s.name}` is not in the DESIGN.md "
+                "fault-site table"))
+    for name, (_, lineno) in sorted(doc_sites.items()):
+        if name not in code_site_names:
+            findings.append(Finding(
+                "REG004", design_rel, lineno,
+                f"DESIGN.md fault-site table lists `{name}` but no code "
+                "marks it"))
+
+    findings.extend(_check_flags(sources, root))
+    return findings
+
+
+def _defined_flags(sources: list[SourceFile]) -> set[str]:
+    flags: set[str] = set()
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or d[-1] != "add_argument":
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value.startswith("--"):
+                    flags.add(arg.value)
+    return flags
+
+
+def _check_flags(sources: list[SourceFile],
+                 root: pathlib.Path) -> list[Finding]:
+    defined = _defined_flags(sources)
+    findings: list[Finding] = []
+    for doc_name in ("README.md", "docs/DESIGN.md"):
+        doc = root / doc_name
+        if not doc.exists():
+            continue
+        for lineno, line in enumerate(doc.read_text().splitlines(),
+                                      start=1):
+            if "XLA_FLAGS" in line or "--xla" in line:
+                continue   # XLA's own flags, not ours
+            for m in _FLAG_RE.finditer(line):
+                flag = m.group(0)
+                if flag not in defined:
+                    findings.append(Finding(
+                        "REG005", doc_name, lineno,
+                        f"{flag} is referenced here but defined by no "
+                        "argument parser in pbccs_tpu/ or tools/"))
+    return findings
+
+
+# ------------------------------------------------------- exception policy
+
+def analyze_exceptions(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    "EXC001", src.rel, node.lineno,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "too; catch a concrete type (or `Exception` with a "
+                    "stated reason)"))
+                continue
+            d = dotted_name(node.type)
+            broad = d is not None and d[-1] in ("Exception",
+                                                "BaseException")
+            silent = (len(node.body) == 1
+                      and isinstance(node.body[0], ast.Pass))
+            if broad and silent:
+                line = src.line_text(node.lineno)
+                if "noqa" in line or "ccs-analyze" in line:
+                    continue
+                findings.append(Finding(
+                    "EXC002", src.rel, node.lineno,
+                    f"silent `except {d[-1]}: pass` swallows every error "
+                    "with no stated reason (narrow the type, log it, or "
+                    "annotate why)"))
+    return findings

@@ -343,6 +343,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.sched.warmup import run_warmup
 
         return run_warmup(argv[1:])
+    if argv and argv[0] == "analyze":
+        # `ccs analyze`: project-native static analysis (pbccs_tpu/analysis)
+        from pbccs_tpu.analysis.cli import run_analyze
+
+        return run_analyze(argv[1:])
     args = build_parser().parse_args(argv)
     apply_resilience_args(args)
 

@@ -322,7 +322,8 @@ def banded_forward(read, read_len, tpl, trans, tpl_len, width: int,
     prev_col = vals[jnp.maximum(J - 1, 0)]
     prev_off = offsets[jnp.maximum(J - 1, 0)]
     a_prev = _gather_band(prev_col, prev_off, (I - 1)[None])[0]
-    em_last = jnp.where(read_i32[jnp.clip(I - 1, 0, Imax - 1)] == tpl_i32[jnp.clip(J - 1, 0, Jmax - 1)],
+    em_last = jnp.where(read_i32[jnp.clip(I - 1, 0, Imax - 1)]
+                        == tpl_i32[jnp.clip(J - 1, 0, Jmax - 1)],
                         em_hit, em_miss)
     final = a_prev * em_last
     vals = vals.at[J].set(jnp.zeros(W).at[I % W].set(final))

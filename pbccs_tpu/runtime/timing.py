@@ -115,13 +115,22 @@ def reset() -> None:
         _window = _registry.scope()
 
 
+def _module_window() -> _metrics.MeasurementScope:
+    """The module-default window, read under the same lock reset() swaps
+    it under: a getter racing a reset() must see one coherent scope, not
+    whatever the interpreter happened to publish (the Logger.default()
+    race of PR 2, in sibling form)."""
+    with _window_lock:
+        return _window
+
+
 def stage_seconds(win: _metrics.MeasurementScope | None = None
                   ) -> dict[str, float]:
     """Per-stage accumulated THREAD time over the given window (default:
     the module window, i.e. since the last reset()).  With overlapped
     workers the stages can sum past wall time; the e2e attribution
     compares each stage against wall to find what binds the 1-core host."""
-    win = win or _window
+    win = win or _module_window()
     # stages untouched inside the window are dropped (zero delta), which
     # matches the old cleared-dict-on-reset surface
     return {dict(labels)["stage"]: v
@@ -130,8 +139,8 @@ def stage_seconds(win: _metrics.MeasurementScope | None = None
 
 def device_wait_seconds(win: _metrics.MeasurementScope | None = None
                         ) -> float:
-    return (win or _window).counter_value(DEVICE_WAIT_SECONDS)
+    return (win or _module_window()).counter_value(DEVICE_WAIT_SECONDS)
 
 
 def fetch_count(win: _metrics.MeasurementScope | None = None) -> int:
-    return int((win or _window).counter_value(DEVICE_FETCHES))
+    return int((win or _module_window()).counter_value(DEVICE_FETCHES))

@@ -233,7 +233,7 @@ class CcsEngine:
                 devs = select_devices(self.config.devices)
             except ValueError as e:
                 raise ValueError(f"ServeConfig.devices: {e}") from None
-            self._pool = DevicePool(
+            pool = DevicePool(
                 devs, DevicePoolConfig(policy=self.config.sched_policy),
                 logger=self._log)
             n_polish = 0
@@ -241,11 +241,17 @@ class CcsEngine:
             # possibly-slow client socket, bounded only by the session's
             # idle timeout): hand them to a dedicated thread so a stalled
             # send blocks this thread, never a device executor
-            self._complete_queue = queue.Queue()
-            self._complete_thread = threading.Thread(
+            complete_queue = queue.Queue()
+            complete_thread = threading.Thread(
                 target=self._completion_worker, daemon=True,
                 name="ccs-serve-complete")
-            self._complete_thread.start()
+            # publish under the lock: status() and close() read these
+            # attributes from other threads (ccs-analyze CONC001)
+            with self._lock:
+                self._pool = pool
+                self._complete_queue = complete_queue
+                self._complete_thread = complete_thread
+            complete_thread.start()
         self._threads = [
             threading.Thread(target=self._prep_worker, daemon=True,
                              name=f"ccs-serve-prep-{i}")
@@ -328,21 +334,26 @@ class CcsEngine:
             t.join(timeout=10.0)
         with self._lock:
             aborted = self._abort
-        if self._pool is not None:
+            pool = self._pool
+            complete_thread = self._complete_thread
+            complete_queue = self._complete_queue
+        if pool is not None:
             # draining already waited for in-flight batches; an abort
             # fails queued pool tasks (their callbacks complete the
             # requests with a structured error) and bounds the worker
             # joins like the legacy polish-worker path, so a hung device
             # program cannot hold the drain-deadline fallback hostage
-            self._pool.close(wait=not aborted,
-                             join_timeout_s=10.0 if aborted else 60.0)
-            self._pool = None
-        if self._complete_thread is not None:
+            pool.close(wait=not aborted,
+                       join_timeout_s=10.0 if aborted else 60.0)
+            with self._lock:
+                self._pool = None
+        if complete_thread is not None:
             # after pool.close() every settled future has enqueued its
             # completion; the sentinel lands behind them all
-            self._complete_queue.put(None)
-            self._complete_thread.join(timeout=10.0)
-            self._complete_thread = None
+            complete_queue.put(None)
+            complete_thread.join(timeout=10.0)
+            with self._lock:
+                self._complete_thread = None
         if aborted:
             # fail whatever is still parked anywhere
             leftovers = [i.payload[0] for b in self._batcher.drain()
@@ -654,9 +665,9 @@ class CcsEngine:
                 in_flight_batches=self._in_flight_batches,
                 in_flight_zmws=self._in_flight_zmws,
             )
+            pool = self._pool   # close() nulls this under the same lock
         stage_s = {k: round(v, 4)
                    for k, v in timing.stage_seconds(self._window).items()}
-        pool = self._pool   # close() may null the attribute concurrently
         sched = {"sched": pool.status()} if pool is not None else {}
         return {
             "engine": "ccs-serve",

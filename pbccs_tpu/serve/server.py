@@ -66,6 +66,11 @@ class _Session:
         self.alive = True
         self.closing = False      # server-initiated close (drain/shutdown)
         self._wlock = threading.Lock()
+        # alive transitions get their OWN lock: _wlock is held across a
+        # blocking sendall (frame atomicity), so taking it just to flip
+        # the flag would let one wedged completer stall the reader's
+        # teardown (and with --idleTimeout 0, stall it forever)
+        self._slock = threading.Lock()
         self._ilock = threading.Lock()
         self._inflight = 0
 
@@ -82,12 +87,16 @@ class _Session:
             with self._wlock:
                 self.conn.sendall(data)
         except OSError as e:
-            if self.alive and not self.closing:
+            # `alive` is read/written by the reader thread and every
+            # completer that replies here: transition it under the state
+            # lock so exactly one path logs the death (ccs-analyze CONC001)
+            with self._slock:
+                was_alive, self.alive = self.alive, False
+            if was_alive and not self.closing:
                 self.server.log.debug(
                     f"session {self.peer}: send failed ({e!r}); "
                     "marking session dead")
                 _count_abort("send_failed")
-            self.alive = False
 
     # ------------------------------------------------------------- verbs
 
@@ -258,7 +267,8 @@ class _Session:
                 if line.strip():
                     self._dispatch(line)
         finally:
-            self.alive = False
+            with self._slock:
+                self.alive = False
             if cause is not None:
                 _count_abort(cause)
                 log.debug(f"session {self.peer} aborted: {cause}")

@@ -1,0 +1,5 @@
+"""ANA002 positive: this file does not parse (unbalanced paren)."""
+
+
+def broken(:
+    return 1

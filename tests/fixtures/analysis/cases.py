@@ -1,0 +1,19 @@
+"""The rule -> fixture map shared by tests/test_analysis.py and
+tools/analyze_smoke.py (one source of truth, so the two gates cannot
+drift).  Each AST rule has one minimal positive and one negative case;
+rules without files here are covered by constructed-repo tests
+(REG001-005 need a docs tree; ANA001 needs a baseline file)."""
+
+# rule id -> (positive fixture, negative fixture)
+AST_CASES = {
+    "CONC001": ("conc001_pos.py", "conc001_neg.py"),
+    "CONC002": ("conc002_pos.py", "conc002_neg.py"),
+    "CONC003": ("conc003_pos.py", "conc003_neg.py"),
+    "JAX001": ("jax001_pos.py", "jax001_neg.py"),
+    "JAX002": ("jax002_pos.py", "jax002_neg.py"),
+    "JAX003": ("jax003_pos.py", "jax003_neg.py"),
+    "JAX004": ("jax004_pos.py", "jax004_neg.py"),
+    "EXC001": ("exc001_pos.py", "exc001_neg.py"),
+    "EXC002": ("exc002_pos.py", "exc002_neg.py"),
+    "ANA002": ("ana002_pos.py", None),   # any parseable file is the neg
+}

@@ -1,0 +1,21 @@
+"""CONC001 negative: every cross-method write holds the lock (and the
+Condition alias over the same lock counts as holding it)."""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.value = 0
+        self.total = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+            self.total += 1
+
+    def reset(self):
+        with self._cv:       # same lock, via the Condition alias
+            self.value = 0
+            self.total = 0

@@ -1,0 +1,37 @@
+"""CONC001 positive: `total` is written from two methods, unguarded."""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.total = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+        self.total += 1      # write outside the lock
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+        self.total = 0       # second method, also outside the lock
+
+
+class SplitLocks:
+    """Every write holds A lock -- but not the SAME lock: no mutual
+    exclusion exists between bump() and reset()."""
+
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+        self.shared = 0
+
+    def bump(self):
+        with self._la:
+            self.shared += 1
+
+    def reset(self):
+        with self._lb:
+            self.shared = 0

@@ -1,0 +1,28 @@
+"""CONC002 negative: blocking work happens outside the lock; waiting on
+the HELD condition (which releases it) is the one legal wait; str.join
+and os.path.join are not thread joins."""
+import os
+import threading
+
+
+class Collector:
+    def __init__(self, work_queue):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.queue = work_queue
+        self.last = None
+        self.ready = False
+
+    def harvest(self, future, names):
+        result = future.result()            # blocking, but no lock held
+        item = self.queue.get()
+        with self._lock:
+            self.last = result
+            label = ", ".join(names)        # str.join, not thread.join
+            path = os.path.join("a", "b")   # os.path.join
+        return item, label, path
+
+    def await_ready(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait()             # waiting on the held condition

@@ -1,0 +1,16 @@
+"""CONC002 positive: blocking calls inside a with-lock body."""
+import threading
+
+
+class Collector:
+    def __init__(self, work_queue):
+        self._lock = threading.Lock()
+        self.queue = work_queue
+        self.last = None
+
+    def harvest(self, future, worker):
+        with self._lock:
+            self.last = future.result()     # Future.result under lock
+            item = self.queue.get()         # queue .get under lock
+            worker.join()                   # thread join under lock
+        return item

@@ -1,0 +1,60 @@
+"""CONC003 negative: lock acquisition order is consistent (always
+Left._lock before Right._lock) -- the graph is acyclic."""
+import threading
+
+
+class Left:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.right = Right()
+
+    def poke(self):
+        with self._lock:
+            self.right.poke_back()   # Left._lock -> Right._lock only
+
+    def poked(self):
+        with self._lock:
+            pass
+
+
+class Right:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke_back(self):
+        with self._lock:
+            pass
+
+    def tickle(self):
+        with self._lock:
+            pass                     # never calls back into Left
+
+
+class DeferredLeft:
+    """The would-be back edge lives in a nested def (a callback that
+    runs later, in another execution context): no inline cycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.right = DeferredRight()
+
+    def poke(self):
+        with self._lock:
+            self.right.enqueue()     # enqueue acquires nothing inline
+
+    def poked(self):
+        with self._lock:
+            pass
+
+
+class DeferredRight:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.left = DeferredLeft()
+
+    def enqueue(self):
+        def later():                 # runs on another thread, later
+            with self._lock:
+                self.left.poked()
+
+        return later

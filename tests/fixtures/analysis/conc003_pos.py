@@ -1,0 +1,31 @@
+"""CONC003 positive: Left takes its lock then calls into Right (which
+takes Right's lock); Right does the reverse -- an AB/BA deadlock."""
+import threading
+
+
+class Left:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.right = Right()
+
+    def poke(self):
+        with self._lock:
+            self.right.poke_back()   # Left._lock -> Right._lock
+
+    def poked(self):
+        with self._lock:
+            pass
+
+
+class Right:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.left = Left()
+
+    def poke_back(self):
+        with self._lock:
+            pass
+
+    def tickle(self):
+        with self._lock:
+            self.left.poked()        # Right._lock -> Left._lock

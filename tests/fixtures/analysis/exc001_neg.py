@@ -1,0 +1,8 @@
+"""EXC001 negative: concrete exception types."""
+
+
+def risky(fn):
+    try:
+        return fn()
+    except (ValueError, OSError):
+        return None

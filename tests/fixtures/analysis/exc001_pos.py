@@ -1,0 +1,8 @@
+"""EXC001 positive: a bare except."""
+
+
+def risky(fn):
+    try:
+        return fn()
+    except:                        # noqa would not matter: bare is bare
+        return None

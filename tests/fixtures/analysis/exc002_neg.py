@@ -1,0 +1,22 @@
+"""EXC002 negative: the swallow states its reason (or narrows, or acts)."""
+
+
+def best_effort(fn, log):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 -- cleanup path must never raise
+        pass
+
+
+def best_effort_logged(fn, log):
+    try:
+        fn()
+    except Exception as e:
+        log.debug(f"ignored: {e!r}")
+
+
+def best_effort_narrow(fn):
+    try:
+        fn()
+    except OSError:
+        pass

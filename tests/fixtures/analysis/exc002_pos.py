@@ -1,0 +1,8 @@
+"""EXC002 positive: a silent broad swallow with no stated reason."""
+
+
+def best_effort(fn):
+    try:
+        fn()
+    except Exception:
+        pass

@@ -1,0 +1,19 @@
+"""JAX001 negative: branches on static args, shape metadata, and
+identity checks are all static under trace."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flip",))
+def step(x, flip, mask=None):
+    if flip:                       # static_argnames -> static
+        x = -x
+    if mask is None:               # identity check on the tracer: static
+        return x
+    if x.ndim > 1:                 # shape metadata: static
+        x = x.sum(axis=0)
+    for _ in range(len(mask)):     # len() of a traced value: static
+        x = jnp.where(mask, x, 0.0)
+    return x

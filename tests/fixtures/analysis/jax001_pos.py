@@ -1,0 +1,19 @@
+"""JAX001 positive: Python control flow on traced values inside jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_positive(x):
+    if x > 0:                      # traced param in a Python `if`
+        return x
+    return -x
+
+
+@jax.jit
+def iterate(x, tol):
+    err = jnp.abs(x)
+    while err > tol:               # traced value in a Python `while`
+        x = x * 0.5
+        err = jnp.abs(x)
+    return x

@@ -1,0 +1,17 @@
+"""JAX002 negative: conversions of static metadata are fine, and host
+syncs OUTSIDE jit-reachable code are the normal way to read results."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def scale(x):
+    n = float(len(x))              # len() of a tracer is static
+    w = int(x.shape[0])            # shape metadata is static
+    return x * (w / n)
+
+
+def driver(x):                     # not jit-reachable: syncs are fine
+    y = scale(x)
+    return float(jnp.sum(y)), np.asarray(y)

@@ -1,0 +1,12 @@
+"""JAX002 positive: host syncs on traced values inside jit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def summarize(x):
+    total = float(jnp.sum(x))      # float() concretizes the tracer
+    host = np.asarray(x)           # device-to-host transfer
+    first = x.sum().item()         # .item() is a sync
+    return total, host, first

@@ -1,0 +1,8 @@
+"""JAX003 negative: formatting static metadata is fine."""
+import jax
+
+
+@jax.jit
+def tagged(x):
+    label = f"shape={x.shape} ndim={x.ndim}"    # static metadata
+    return x, label

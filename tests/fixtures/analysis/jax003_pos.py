@@ -1,0 +1,11 @@
+"""JAX003 positive: formatting traced values inside jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy(x):
+    total = jnp.sum(x)
+    label = f"total={total}"       # f-string over a tracer
+    name = str(total)              # str() over a tracer
+    return x, label, name

@@ -1,0 +1,26 @@
+"""JAX004 negative: module-level jit, and memoized jit factories."""
+import functools
+
+import jax
+
+
+def _body(v):
+    return v + 1
+
+
+apply = jax.jit(_body)             # module level: one cache, reused
+
+
+@functools.lru_cache
+def make_scaler(k):
+    return jax.jit(lambda v: v * k)    # memoized factory: one per k
+
+
+def setup(n):
+    @functools.lru_cache
+    def factory(k):
+        # the memoized frame is NESTED inside a plain function: still
+        # one wrapper per key, still exempt
+        return jax.jit(lambda v: v + n + k)
+
+    return factory

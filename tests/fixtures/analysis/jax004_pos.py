@@ -1,0 +1,14 @@
+"""JAX004 positive: a fresh jit wrapper (empty compile cache) per call."""
+import jax
+
+
+def apply_scaled(x, k):
+    f = jax.jit(lambda v: v * k)   # new jit object every apply_scaled call
+    return f(x)
+
+
+def apply_local(x):
+    def body(v):
+        return v + 1
+
+    return jax.jit(body)(x)        # ditto, via a locally-defined function

@@ -1,0 +1,394 @@
+"""Tests for the `ccs analyze` static-analysis suite (pbccs_tpu/analysis).
+
+Covers: one positive + one negative fixture per AST rule id
+(tests/fixtures/analysis/), the registry drift rules over a constructed
+mini-repo, baseline mechanics (suppression, stale-entry ANA001, inline
+comments), the clean-repo gate, and regression tests for the
+concurrency fixes this analyzer forced (engine attribute publication,
+timing window getters)."""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import textwrap
+import threading
+
+import pytest
+
+from pbccs_tpu.analysis import RULES, run_passes
+from pbccs_tpu.analysis.baseline import (
+    BaselineError,
+    Suppression,
+    apply_baseline,
+    load_baseline,
+)
+from pbccs_tpu.analysis.core import Finding, load_source
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+
+_spec = importlib.util.spec_from_file_location("cases", FIXTURES / "cases.py")
+_cases = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_cases)
+AST_CASES = _cases.AST_CASES
+
+
+def rules_in(name: str) -> set[str]:
+    findings = run_passes(FIXTURES, paths=[FIXTURES / name])
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------- rule fixtures
+
+@pytest.mark.parametrize("rule", sorted(AST_CASES))
+def test_rule_fires_on_positive_fixture(rule):
+    pos, _ = AST_CASES[rule]
+    assert rule in rules_in(pos), f"{rule} must fire on {pos}"
+
+
+@pytest.mark.parametrize("rule", sorted(AST_CASES))
+def test_rule_quiet_on_negative_fixture(rule):
+    _, neg = AST_CASES[rule]
+    if neg is None:
+        pytest.skip("no dedicated negative (any parseable file)")
+    found = rules_in(neg)
+    assert rule not in found, f"{rule} must not fire on {neg}: {found}"
+
+
+def test_every_ast_rule_has_fixtures():
+    """Adding a rule without fixtures fails here (the DESIGN.md 'how to
+    add a rule' contract)."""
+    constructed = {"REG001", "REG002", "REG003", "REG004", "REG005",
+                   "ANA001"}
+    missing = set(RULES) - set(AST_CASES) - constructed
+    assert not missing, f"rules without fixture coverage: {missing}"
+
+
+def test_negative_fixtures_fully_clean():
+    """Negative fixtures carry no findings of ANY rule -- they document
+    the idioms the analyzer must never punish."""
+    for rule, (_, neg) in sorted(AST_CASES.items()):
+        if neg is None:
+            continue
+        findings = run_passes(FIXTURES, paths=[FIXTURES / neg])
+        assert not findings, f"{neg} must be clean, got {findings}"
+
+
+def test_ana002_syntax_error_reports_not_raises():
+    src, err = load_source(FIXTURES / "ana002_pos.py", FIXTURES)
+    assert src is None
+    assert err is not None and err.rule == "ANA002"
+
+
+# ------------------------------------------------------- registry drift
+
+def _mini_repo(tmp_path: pathlib.Path) -> pathlib.Path:
+    pkg = tmp_path / "pbccs_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent("""\
+        import argparse
+
+
+        def setup(reg, faults):
+            reg.counter("ccs_real_total", "a real metric")
+            faults.maybe_fail("real.site")
+            p = argparse.ArgumentParser()
+            p.add_argument("--real")
+            return p
+    """))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "DESIGN.md").write_text(textwrap.dedent("""\
+        # mini design
+        <!-- ccs-analyze:metrics-table:begin -->
+        | metric | kind | labels | source |
+        |---|---|---|---|
+        | `ccs_ghost_total` | counter | — | `gone.py` |
+        <!-- ccs-analyze:metrics-table:end -->
+        <!-- ccs-analyze:fault-sites-table:begin -->
+        | fault site | marker | source |
+        |---|---|---|
+        | `ghost.site` | maybe_fail() | `gone.py` |
+        <!-- ccs-analyze:fault-sites-table:end -->
+    """))
+    (tmp_path / "README.md").write_text(
+        "Run with `--real` or the removed `--ghost`.\n")
+    return tmp_path
+
+
+def test_registry_drift_rules(tmp_path):
+    root = _mini_repo(tmp_path)
+    found = {f.rule: f for f in run_passes(root)}
+    assert "REG001" in found        # ccs_real_total not in the table
+    assert "ccs_real_total" in found["REG001"].message
+    assert "REG002" in found        # ccs_ghost_total only in the table
+    assert "ccs_ghost_total" in found["REG002"].message
+    assert "REG003" in found and "real.site" in found["REG003"].message
+    assert "REG004" in found and "ghost.site" in found["REG004"].message
+    assert "REG005" in found and "--ghost" in found["REG005"].message
+    # --real is defined: must not be reported
+    assert all("--real " not in f.message
+               for f in found.values() if f.rule == "REG005")
+
+
+def test_registry_green_when_tables_match(tmp_path):
+    root = _mini_repo(tmp_path)
+    (root / "docs" / "DESIGN.md").write_text(textwrap.dedent("""\
+        <!-- ccs-analyze:metrics-table:begin -->
+        | `ccs_real_total` | counter | — | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:metrics-table:end -->
+        <!-- ccs-analyze:fault-sites-table:begin -->
+        | `real.site` | maybe_fail() | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:fault-sites-table:end -->
+    """))
+    (root / "README.md").write_text("Run with `--real`.\n")
+    assert [f for f in run_passes(root)
+            if f.rule.startswith("REG")] == []
+
+
+def test_metric_kind_mismatch_is_drift(tmp_path):
+    root = _mini_repo(tmp_path)
+    (root / "docs" / "DESIGN.md").write_text(textwrap.dedent("""\
+        <!-- ccs-analyze:metrics-table:begin -->
+        | `ccs_real_total` | gauge | — | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:metrics-table:end -->
+        <!-- ccs-analyze:fault-sites-table:begin -->
+        | `real.site` | maybe_fail() | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:fault-sites-table:end -->
+    """))
+    (root / "README.md").write_text("plain\n")
+    reg1 = [f for f in run_passes(root) if f.rule == "REG001"]
+    assert reg1 and "listed as `gauge`" in reg1[0].message
+
+
+# ------------------------------------------------------------- baseline
+
+def _findings():
+    return [Finding("CONC002", "pbccs_tpu/x.py", 10, "sendall under lock")]
+
+
+def test_baseline_suppresses_matching_finding():
+    sup = [Suppression("CONC002", "pbccs_tpu/x.py", match="sendall")]
+    kept, n = apply_baseline(_findings(), sup, "baseline.toml")
+    assert kept == [] and n == 1
+
+
+def test_stale_baseline_entry_reported_as_ana001():
+    sup = [
+        Suppression("CONC002", "pbccs_tpu/x.py", match="sendall"),
+        Suppression("JAX001", "pbccs_tpu/gone.py",
+                    reason="code was deleted"),
+    ]
+    kept, n = apply_baseline(_findings(), sup, "baseline.toml")
+    assert n == 1
+    assert [f.rule for f in kept] == ["ANA001"]
+    assert "pbccs_tpu/gone.py" in kept[0].message
+
+
+def test_baseline_never_matches_by_line():
+    sup = [Suppression("CONC002", "pbccs_tpu/x.py")]
+    moved = [Finding("CONC002", "pbccs_tpu/x.py", 999, "sendall moved")]
+    kept, n = apply_baseline(moved, sup, "baseline.toml")
+    assert kept == [] and n == 1
+
+
+def test_malformed_baseline_raises(tmp_path):
+    bad = tmp_path / "baseline.toml"
+    bad.write_text("[[suppress]]\nrule = \n")
+    with pytest.raises(BaselineError):
+        load_baseline(bad)
+
+
+def test_committed_baseline_parses_and_is_small():
+    sups = load_baseline(REPO / "pbccs_tpu" / "analysis" / "baseline.toml")
+    assert len(sups) <= 10, "baseline must stay a short, justified list"
+    assert all(s.reason for s in sups), "every suppression needs a reason"
+
+
+def test_inline_suppression_silences_finding(tmp_path):
+    f = tmp_path / "sup.py"
+    f.write_text(textwrap.dedent("""\
+        def risky(fn):
+            try:
+                return fn()
+            except:  # ccs-analyze: ignore[EXC001]
+                return None
+
+
+        def risky2(fn):
+            try:
+                return fn()
+            # ccs-analyze: ignore[EXC001] -- comment-line form
+            except:
+                return None
+    """))
+    findings = run_passes(tmp_path, paths=[f])
+    assert findings == []
+
+
+# ------------------------------------------------------ clean-repo gate
+
+def test_repo_is_clean_under_committed_baseline():
+    """The tier-1 contract: the repo analyzes clean (this is also what
+    tools/analyze_smoke.py gates in CI)."""
+    from pbccs_tpu.analysis.cli import run_analyze
+
+    assert run_analyze(["--root", str(REPO)]) == 0
+
+
+def test_scoped_runs_do_not_report_out_of_scope_suppressions_stale():
+    """A --rules or path-scoped run only sees suppressions it could have
+    matched; the committed CONC002 baseline entries must not surface as
+    ANA001 when the run is filtered to unrelated rules/paths."""
+    from pbccs_tpu.analysis.cli import run_analyze
+
+    assert run_analyze(["--root", str(REPO),
+                        "--rules", "EXC001,EXC002"]) == 0
+    assert run_analyze(["--root", str(REPO),
+                        str(REPO / "pbccs_tpu" / "runtime" / "timing.py")
+                        ]) == 0
+
+
+def test_broken_pipe_keeps_failure_exit_code(tmp_path, monkeypatch):
+    """`ccs analyze | head` on a dirty repo: the consumer closing the
+    pipe truncates OUTPUT but must not flip the exit code to clean."""
+    import sys
+
+    from pbccs_tpu.analysis.cli import run_analyze
+
+    (tmp_path / "bad.py").write_text(
+        "def f(fn):\n    try:\n        return fn()\n"
+        "    except:\n        return None\n")
+
+    class _ClosedPipe:
+        def write(self, s):
+            raise BrokenPipeError
+
+        def flush(self):
+            pass
+
+    monkeypatch.setattr(sys, "stdout", _ClosedPipe())
+    rc = run_analyze(["--root", str(tmp_path), "--no-baseline"])
+    assert rc == 1
+
+
+def test_jaxlint_checks_except_bodies_and_with_context_exprs(tmp_path):
+    """ast.ExceptHandler and ast.withitem are neither stmt nor expr: the
+    taint walker must recurse into them explicitly or `except:` bodies
+    and `with` context expressions go silently unchecked."""
+    f = tmp_path / "containers.py"
+    f.write_text(textwrap.dedent("""\
+        import jax
+
+
+        @jax.jit
+        def f(x, ctx):
+            y = x + 1
+            try:
+                y = y * 2
+            except ValueError:
+                if x > 0:
+                    y = x
+            with ctx(float(x)):
+                y = y - 1
+            return y
+    """))
+    rules = [fi.rule for fi in run_passes(tmp_path, paths=[f])]
+    assert "JAX001" in rules, "branch on tracer inside except body"
+    assert "JAX002" in rules, "host sync inside with context expr"
+
+
+# ----------------------------------- regressions pinned by analyzer fixes
+
+def test_session_teardown_not_blocked_by_wedged_writer():
+    """serve/server.py: the reader's teardown flips `alive` under the
+    dedicated state lock, never `_wlock` -- a completer wedged mid-
+    sendall (peer stopped reading) must not stall session close."""
+    from types import SimpleNamespace
+
+    from pbccs_tpu.serve.server import _Session
+
+    class _Conn:
+        def settimeout(self, t):
+            pass
+
+        def recv(self, n):
+            return b""          # immediate EOF from the peer
+
+        def close(self):
+            pass
+
+    log = SimpleNamespace(debug=lambda *a, **k: None)
+    server = SimpleNamespace(
+        log=log,
+        engine=SimpleNamespace(config=SimpleNamespace(
+            idle_timeout_s=0, max_line_bytes=1024)),
+        _forget=lambda s: None)
+    sess = _Session(server, _Conn(), ("test", 0))
+    with sess._wlock:           # the wedged completer
+        t = threading.Thread(target=sess.run)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "teardown must not wait on _wlock"
+    assert sess.alive is False
+
+
+def test_timing_window_getters_race_with_reset():
+    """CONC audit fix (runtime/timing.py): getters read the module
+    window under the same lock reset() swaps it under."""
+    from pbccs_tpu.runtime import timing
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def hammer(fn):
+        try:
+            while not stop.is_set():
+                fn()
+        except BaseException as e:  # noqa: BLE001 -- surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(f,)) for f in
+               (timing.reset, timing.stage_seconds,
+                timing.device_wait_seconds, timing.fetch_count)]
+    for t in threads:
+        t.start()
+    stop.wait(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+
+
+def test_engine_status_during_close_race():
+    """CONC001 fix (serve/engine.py): _pool/_complete_thread publication
+    is lock-guarded, so status() racing close() sees coherent state."""
+    from pbccs_tpu.pipeline import Failure
+    from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+
+    def prep_fn(chunk, settings):
+        return Failure.SUCCESS, None
+
+    def polish_fn(preps, settings, **kw):
+        return [(Failure.SUCCESS, None) for _ in preps]
+
+    for _ in range(3):
+        eng = CcsEngine(config=ServeConfig(prep_workers=1),
+                        prep_fn=prep_fn, polish_fn=polish_fn).start()
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def poll():
+            try:
+                while not stop.is_set():
+                    eng.status()
+            except BaseException as e:  # noqa: BLE001 -- surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        eng.close()
+        stop.set()
+        t.join(timeout=5)
+        assert not errors

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Tier-1 gate for `ccs analyze` (pbccs_tpu/analysis).
+
+Three assertions, mirroring the acceptance contract:
+
+  1. the repository analyzes CLEAN against the committed baseline
+     (exit 0), i.e. no unsuppressed finding and no stale suppression;
+  2. the full run stays under 30 s (it is pure AST; a blowup here means
+     a pass grew an accidental O(n^2));
+  3. every AST rule still FIRES on its positive fixture -- a refactor
+     that silently lobotomizes a pass fails CI even though the repo
+     "looks clean".
+
+Run it exactly as CI does:   python tools/analyze_smoke.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from pbccs_tpu.analysis import run_passes  # noqa: E402
+from pbccs_tpu.analysis.cli import run_analyze  # noqa: E402
+
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+BUDGET_S = 30.0
+
+
+def _load_cases() -> dict:
+    spec = importlib.util.spec_from_file_location(
+        "cases", FIXTURES / "cases.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.AST_CASES
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    rc = run_analyze(["--root", str(REPO)])
+    dt = time.perf_counter() - t0
+    print(f"analyze_smoke: repo run rc={rc} in {dt:.2f}s "
+          f"(budget {BUDGET_S:.0f}s)")
+    if rc != 0:
+        print("analyze_smoke: FAIL -- `ccs analyze` must exit 0 on the "
+              "repo against the committed baseline", file=sys.stderr)
+        return 1
+    if dt >= BUDGET_S:
+        print(f"analyze_smoke: FAIL -- analyzer took {dt:.1f}s "
+              f"(>= {BUDGET_S:.0f}s budget)", file=sys.stderr)
+        return 1
+
+    bad = 0
+    for rule, (pos, _neg) in sorted(_load_cases().items()):
+        findings = run_passes(FIXTURES, paths=[FIXTURES / pos])
+        fired = any(f.rule == rule for f in findings)
+        # the CLI contract: a positive fixture makes `ccs analyze` exit
+        # non-zero (path-scoped, no baseline)
+        cli_rc = run_analyze(["--root", str(FIXTURES), "--no-baseline",
+                              str(FIXTURES / pos)])
+        print(f"analyze_smoke: {rule} on {pos}: "
+              f"{'fires' if fired else 'SILENT'} (cli rc={cli_rc})")
+        if not fired or cli_rc == 0:
+            bad += 1
+    if bad:
+        print(f"analyze_smoke: FAIL -- {bad} rule(s) no longer fire on "
+              "their positive fixtures", file=sys.stderr)
+        return 1
+    print("analyze_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

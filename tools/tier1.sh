@@ -9,6 +9,20 @@ cd "$(dirname "$0")/.."
 echo "== compileall gate =="
 python -m compileall -q pbccs_tpu tools || exit 1
 
+echo "== static analysis (ccs analyze: lock discipline / tracer hygiene / registry drift) =="
+# clean vs the committed baseline, <30s, and every rule still fires on
+# its positive fixture; runtime is printed by the smoke itself
+timeout -k 10 120 python tools/analyze_smoke.py || exit 1
+
+echo "== ruff (style gate; import order advisory) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check . || exit 1
+    # import-block ordering: reported, not yet enforced (ruff.toml)
+    ruff check --select I001 --exit-zero --statistics . 2>/dev/null || true
+else
+    echo "ruff not installed; skipping (CI installs and enforces it)"
+fi
+
 echo "== observability smoke (trace schema) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
