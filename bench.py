@@ -65,6 +65,50 @@ def build_tasks(rng, n_zmws: int, tpl_len: int, n_passes, n_corruptions: int):
     return tasks, truths
 
 
+def _regions_enabled() -> bool:
+    """Per-row device-region attribution default: on for accelerator
+    platforms, off on CPU (no device lanes to attribute and the xprof
+    wheel may be absent).  BENCH_TRACE_REGIONS=1/0 overrides."""
+    env = os.environ.get("BENCH_TRACE_REGIONS")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "off", "no", "")
+    try:
+        import jax
+
+        return jax.devices()[0].platform != "cpu"
+    except Exception:  # noqa: BLE001 -- attribution is best-effort
+        return False
+
+
+def trace_regions(run_fn) -> dict | None:
+    """Capture ONE jax.profiler trace of run_fn() and attribute device
+    self-time to the PROFILE region buckets (tools/trace_polish
+    region_rollup).  Returns {"total_ms", "kernel_fraction", "regions"}
+    or an {"error": ...} dict -- attribution must never fail a bench."""
+    import shutil
+    import sys
+    import tempfile
+
+    import jax
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    out = tempfile.mkdtemp(prefix="pbccs_regions_")
+    try:
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        import trace_polish
+
+        with jax.profiler.trace(out):
+            run_fn()
+        _, rows = trace_polish.parse(out)
+        return trace_polish.region_rollup(rows)
+    except Exception as e:  # noqa: BLE001 -- best-effort attribution
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+
 def _refine_opts():
     """The bench's refinement options — shared by the timed workload and
     the straggler-shape warmup (max_iterations is an executable cache
@@ -153,6 +197,17 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     del pols
     warm_s = time.monotonic() - t0
 
+    # per-row device-region attribution: ONE traced (untimed) pass on a
+    # private rng stream, so the timed repeats and the pinned accuracy
+    # draw are untouched.  Records device_regions_ms + kernel_fraction
+    # per BENCH row -- the round-over-round kernel-share regression
+    # signal (docs/PROFILE_r06.md).
+    regions = None
+    if _regions_enabled():
+        tasks_t, _ = build_tasks(np.random.default_rng(987654321),
+                                 n_zmws, tpl_len, n_passes, n_corruptions)
+        regions = trace_regions(lambda: run_all(tasks_t))
+
     # median of N timed runs: the device link (tunneled on dev hosts) has
     # latency spikes that can halve a single run's throughput, so the
     # median is the comparable statistic across rounds (min/max reported
@@ -219,6 +274,9 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
         "accuracy_draw": "first timed repeat (seed 20260729 draw #2; "
                          "repeat-count-invariant, round-comparable)",
         "banding": banding,
+        **({"device_regions_ms": regions.get("regions", regions),
+            "kernel_fraction": regions.get("kernel_fraction")}
+           if regions is not None else {}),
     }
 
 
@@ -425,6 +483,11 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
             "mean_qv": round(stats["mean_qv"], 2),
             "banding": stats.get("banding", {}),
         }
+        # kernel-share attribution rides every row that captured one
+        # (accelerator runs; see _regions_enabled)
+        if stats.get("device_regions_ms") is not None:
+            entry["device_regions_ms"] = stats["device_regions_ms"]
+            entry["kernel_fraction"] = stats.get("kernel_fraction")
         if env:
             entry["env"] = env
         # _w1 twin rows run the identical workload as their base row, so

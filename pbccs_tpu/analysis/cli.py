@@ -58,8 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-rules", action="store_true",
                    help="Print the rule catalogue and exit.")
     p.add_argument("--emit-tables", action="store_true",
-                   help="Print regenerated DESIGN.md metrics/fault-site "
-                        "tables and exit (paste between the "
+                   help="Print regenerated DESIGN.md metrics/fault-site/"
+                        "env-toggle tables and exit (paste between the "
                         "ccs-analyze markers).")
     p.add_argument("paths", nargs="*",
                    help="Specific files to analyze (default: the whole "
@@ -100,8 +100,11 @@ def _run(args) -> int:
     if args.emit_tables:
         from pbccs_tpu.analysis.core import load_sources
         from pbccs_tpu.analysis.registry import (
+            _table_entries,
+            collect_env_reads,
             collect_fault_sites,
             collect_metrics,
+            render_env_table,
             render_metrics_table,
             render_sites_table,
         )
@@ -111,6 +114,11 @@ def _run(args) -> int:
         print(render_metrics_table(collect_metrics(pkg)))
         print()
         print(render_sites_table(collect_fault_sites(pkg)))
+        print()
+        design = root / "docs" / "DESIGN.md"
+        existing = _table_entries(
+            design.read_text() if design.exists() else "", "env-table")
+        print(render_env_table(collect_env_reads(pkg), existing))
         return 0
 
     rules = ({r.strip() for r in args.rules.split(",") if r.strip()}

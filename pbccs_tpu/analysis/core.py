@@ -52,6 +52,10 @@ RULES = {
               "not marked in code",
     "REG005": "CLI flag referenced in README/DESIGN but defined by no "
               "argument parser",
+    "REG006": "PBCCS_* env toggle read in code but missing from the "
+              "DESIGN.md env-toggle table",
+    "REG007": "env toggle listed in the DESIGN.md env-toggle table but "
+              "read by no code",
     "EXC001": "bare `except:` clause",
     "EXC002": "silent `except Exception/BaseException: pass` without a "
               "stated reason",

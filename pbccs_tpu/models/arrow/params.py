@@ -172,25 +172,32 @@ def effective_band_width(banding: "BandingOptions", jmax: int) -> int:
     for, even under the env override); PBCCS_BAND_W replaces the
     schedule's default choice only.
 
-    Long buckets (> 8192) run W=128: at 15 kb the alignment drift after a
-    big apply round clips the W=96 band even with guided rebanding — one
-    read unmates at the round-1 rebuild and the ZMW runs away on weak
-    evidence (grew +834 bases and overflowed the bucket on the round-5
-    bench draw; W=128 keeps every read mated, 4/4 converge).  Band lanes
-    below the 128-lane VPU width are padding anyway, so the extra width
-    costs only VMEM and window matmuls, not vector throughput.
+    Long buckets (> 8192) run W=96, occupancy-driven (round 6): the
+    round-5 schedule ran them at W=128 because the alignment drift after
+    a big apply round clipped the W=96 band at the round-1 rebuild with
+    TWO guided passes -- one read unmated and the ZMW ran away on weak
+    evidence (+834 bases, bucket overflow, round-5 bench draw).  But the
+    measured cost of the width was real: cfg3's 15 kb band occupancy was
+    0.465 at W=128 (BENCH_r05.json), i.e. more than half the band
+    compute, VMEM, and HBM traffic polished empty lanes.  The round-6
+    schedule fixes the CAUSE instead of widening around it: long buckets
+    run a THIRD argmax-guided refill pass (scorer.guided_fill_passes),
+    which re-centers the band on the post-apply path the round-5 failure
+    drifted off, and keep W=96.  The mating gate still protects
+    correctness (a clipped read drops or triggers the 2x retry, whose
+    explicit band_width bypasses this schedule).  PBCCS_BAND_W replaces
+    the schedule's choice for A/B measurement.
 
     The reference's analogue is the adaptive per-column band itself
     (SimpleRecursor.cpp:693-757), which sizes effort to the data; a static
-    schedule keyed on the compile-time bucket is the XLA-friendly form."""
+    schedule keyed on the compile-time bucket plus guided re-centering is
+    the XLA-friendly form."""
     if banding.band_width is not None:
         return banding.band_width
     env = os.environ.get("PBCCS_BAND_W")
     if env:
         return int(env)
-    if jmax <= 576:
-        return 64
-    return 96 if jmax <= 8192 else 128
+    return 64 if jmax <= 576 else 96
 
 
 @dataclasses.dataclass(frozen=True)

@@ -119,13 +119,19 @@ def guided_fill_passes(jmax: int) -> int:
     Thresholds from the drift model (std ~ sqrt(2 * p_indel * L) rows):
     at 2 kb measured drift is +-16 (well inside W/2 = 48, no passes); at
     3 kb ~2 sigma reaches W/2 (start guiding); by 8 kb+ the diagonal can
-    be multiple band-widths off (two passes)."""
+    be multiple band-widths off.  Buckets past 8 kb run THREE passes
+    (round 6): the third pass is what lets the occupancy-driven W
+    schedule (params.effective_band_width) hold W=96 at 15 kb -- the
+    round-5 W=128 escape hatch existed because two passes left one read's
+    post-apply drift outside a 96-row band.  Re-centering is O(fill) and
+    shares the fill executables; width is paid on every fill, score, and
+    VMEM byte of the polish."""
     env = os.environ.get("PBCCS_GUIDED")
     if env is not None:
         return max(0, int(env))
     if jmax <= 3072:
         return 0
-    return 1 if jmax <= 8192 else 2
+    return 1 if jmax <= 8192 else 3
 
 
 def fill_alpha_beta_batch(reads, rlens, win_tpl, win_trans, wlens, width: int,

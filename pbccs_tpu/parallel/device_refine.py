@@ -32,6 +32,7 @@ masked, never reordered, so slot index == candidate identity.
 from __future__ import annotations
 
 import functools
+import typing
 from typing import NamedTuple
 
 import jax
@@ -336,6 +337,13 @@ class RefineLoopState(NamedTuple):
     history: jax.Array      # (Z, H) uint32 template-hash ring
     hist_n: jax.Array       # (Z,) int32
     overflow: jax.Array     # () bool: bail-to-host flag
+    # pre-baked dense-kernel layout (ops.dense_score_pallas.DenseLayout
+    # with (Z, R)-leading leaves), rebuilt only when fills rebuild; None
+    # on the chunked scoring path.  Rounds that apply no mutation (and
+    # the eager QV sweep after the loop) relaunch the kernel on the
+    # previous rebuild's baked buffers instead of re-deriving the
+    # layout in-graph every round.
+    dlayout: typing.Any = None
 
 
 def _chunk_count(jmax: int, chunk: int) -> int:
@@ -356,6 +364,35 @@ def slot_geometry(ts, te, strand, ms, me, is_ins):
     return overlap, interior, wlen
 
 
+def _state_layout(reads, rlens, win_tpl, win_trans, wlens, table,
+                  alpha: BandedMatrix, beta: BandedMatrix, a_prefix,
+                  b_suffix, width: int):
+    """(Z, R)-leading DenseLayout for RefineLoopState.dlayout: flatten
+    the batch to the kernel's (Z*R)-flat read frame, bake the layout
+    (ops.dense_score_pallas.build_dense_layout), reshape leaves back.
+    Plain function for enclosing traces (the loop's rebuild);
+    state_layout below is the jitted prepare-time entry."""
+    from pbccs_tpu.ops.dense_score_pallas import build_dense_layout
+
+    Z, R = reads.shape[:2]
+    flat = lambda a: a.reshape((Z * R,) + a.shape[2:])
+    tables = flat(jnp.broadcast_to(table[:, None],
+                                   (Z, R) + table.shape[1:]))
+    alpha_f = BandedMatrix(flat(alpha.vals), flat(alpha.offsets),
+                           flat(alpha.log_scales))
+    beta_f = BandedMatrix(flat(beta.vals), flat(beta.offsets),
+                          flat(beta.log_scales))
+    lay = build_dense_layout(flat(reads), flat(rlens), flat(win_tpl),
+                             flat(win_trans), flat(wlens), tables,
+                             alpha_f, beta_f, flat(a_prefix),
+                             flat(b_suffix), width)
+    return jax.tree.map(lambda a: a.reshape((Z, R) + a.shape[1:]), lay)
+
+
+state_layout = functools.partial(jax.jit, static_argnames=("width",))(
+    _state_layout)
+
+
 def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
                            table, real_rows, start, end, mtype, base,
                            valid, *, min_fast_edge: int):
@@ -374,6 +411,12 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
         edge_window_scores_batch, splice_edge_rows, window_grid_to_template)
 
     Z, R = reads.shape[:2]
+    # pre-baked kernel layout carried in the loop state: flatten its
+    # (Z, R)-leading leaves to the call's (Z*R)-flat read batch
+    lay = st.dlayout
+    if lay is not None:
+        lay = jax.tree.map(
+            lambda a: a.reshape((Z * R,) + a.shape[2:]), lay)
     jmax = st.tpl.shape[1]
     M = jmax * N_SLOTS
 
@@ -397,8 +440,8 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     beta_f = BandedMatrix(flat(st.beta.vals), flat(st.beta.offsets),
                           flat(st.beta.log_scales))
     f_apre, f_bsuf = flat(st.a_prefix), flat(st.b_suffix)
-    ptrans = jax.vmap(dense_patch_grids)(f_wt.astype(jnp.int32), f_wtr,
-                                         tables, f_wl)
+    ptrans = None if lay is not None else jax.vmap(dense_patch_grids)(
+        f_wt.astype(jnp.int32), f_wtr, tables, f_wl)
     # (read, position-block) live mask: rounds > 0 restrict candidates to
     # nearby windows, so most kernel grid cells have no valid slot and
     # can skip all compute.  A block is live iff any valid candidate
@@ -438,11 +481,14 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     live = live & real_rows[:, :, None] & st.active[:, :, None]
     # one shared per-column read-window computation serves the interior
     # kernel and the edge program (the edge program's former per-read
-    # dynamic slices were ~13% of device time on the round-5 profile)
-    rwin = band_read_windows(f_reads, alpha_f.offsets, W)
+    # dynamic slices were ~13% of device time on the round-5 profile);
+    # with a pre-baked layout even that is already done
+    rwin = None if lay is not None else \
+        band_read_windows(f_reads, alpha_f.offsets, W)
     grid_w = dense_interior_scores_batch(
         f_reads, f_rlens, f_wt, f_wtr, f_wl, tables, alpha_f, beta_f,
-        f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB), rwin)
+        f_apre, f_bsuf, W, ptrans, live.reshape(Z * R, NB), rwin,
+        layout=lay)
 
     # edge slots always compute (not gated behind a cond): the edge
     # program has no data dependence on the kernel output, so XLA
@@ -450,7 +496,7 @@ def _score_slot_grid_dense(st: "RefineLoopState", reads, rlens, strands,
     # rounds that don't need them
     e6 = edge_window_scores_batch(f_reads, f_rlens, f_wt, f_wtr, f_wl,
                                   alpha_f, beta_f, f_apre, f_bsuf,
-                                  ptrans, W, rwin)
+                                  ptrans, W, rwin, layout=lay)
     grid_w = jax.vmap(splice_edge_rows)(grid_w, e6, f_wl.astype(jnp.int32))
     mapped = jax.vmap(
         lambda g, s, a, b: window_grid_to_template(g, s, a, b, jmax)
@@ -722,6 +768,10 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
 
     Z, R = reads.shape[:2]
     Jmax = None  # bound at trace time from state.tpl
+    # whether this trace carries a pre-baked dense layout (static: the
+    # initial state either has one or not; the dense scoring path uses
+    # it when present and rebuilds it whenever the fills rebuild)
+    with_layout = state.dlayout is not None
 
     def rebuild(tpl, tlens, tstarts, tends, active):
         def one_zmw(t, L, tb, st1, ts1, te1):
@@ -740,8 +790,11 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
             guided_passes=guided_passes)
         active = batchmod._update_active.__wrapped__(
             active, ll_a, ll_b, rlens, tstarts, tends)
+        dlay = _state_layout(reads, rlens, win_tpl, win_trans, wlens,
+                             table, alpha, beta, apre, bsuf,
+                             width) if with_layout else None
         return (win_tpl, win_trans, wlens, alpha, beta, apre, bsuf,
-                ll_b, trans_f, tpl_r, trans_r, active)
+                ll_b, trans_f, tpl_r, trans_r, active, dlay)
 
     def score_all(st: RefineLoopState, start, end, mtype, base, valid):
         return score_slot_grid(st, reads, rlens, strands, table, real_rows,
@@ -847,9 +900,9 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
         # converging batch)
         same = (st.win_tpl, st.win_trans, st.wlens, st.alpha, st.beta,
                 st.a_prefix, st.b_suffix, st.baselines, st.trans_f,
-                st.tpl_r, st.trans_r, st.active)
+                st.tpl_r, st.trans_r, st.active, st.dlayout)
         (win_tpl, win_trans, wlens, alpha, beta, apre, bsuf, baselines,
-         trans_f, tpl_r, trans_r, active) = lax.cond(
+         trans_f, tpl_r, trans_r, active, dlayout) = lax.cond(
             apply_mask.any(),
             lambda: rebuild(tpl, tlens, tstarts, tends, st.active),
             lambda: same)
@@ -871,7 +924,7 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
             it=st.it + 1, done=done_now, converged=converged,
             iterations=iterations, n_tested=n_tested, n_applied=n_applied,
             allowed=allowed, history=history, hist_n=hist_n,
-            overflow=overflow)
+            overflow=overflow, dlayout=dlayout)
 
     # Straggler early exit: each lockstep round costs full (Z, ...) compute
     # whatever the active count, so once only a handful of ZMWs remain
@@ -893,11 +946,15 @@ def run_refine_loop(state: "RefineLoopState", reads, rlens, strands, table,
     return lax.while_loop(cond, body, state)
 
 
-def _state_specs(zmw: str, read: str) -> "RefineLoopState":
+def _state_specs(zmw: str, read: str,
+                 with_layout: bool = False) -> "RefineLoopState":
     """PartitionSpec pytree of RefineLoopState under a (zmw, read) mesh:
     per-ZMW planes shard on the zmw axis, per-(ZMW, read) planes on both,
-    scalars replicate."""
+    scalars replicate.  `with_layout` mirrors whether the state carries a
+    pre-baked DenseLayout (all of whose leaves are (Z, R)-leading)."""
     from jax.sharding import PartitionSpec as P
+
+    from pbccs_tpu.ops.dense_score_pallas import DenseLayout
 
     z, zr, rep = P(zmw), P(zmw, read), P()
     bm = BandedMatrix(zr, zr, zr)
@@ -907,7 +964,8 @@ def _state_specs(zmw: str, read: str) -> "RefineLoopState":
         alpha=bm, beta=bm, a_prefix=zr, b_suffix=zr,
         baselines=zr, trans_f=z, tpl_r=z, trans_r=z, active=zr,
         it=rep, done=z, converged=z, iterations=z, n_tested=z,
-        n_applied=z, allowed=z, history=z, hist_n=z, overflow=rep)
+        n_applied=z, allowed=z, history=z, hist_n=z, overflow=rep,
+        dlayout=DenseLayout(*([zr] * 8)) if with_layout else None)
 
 
 @functools.lru_cache(maxsize=64)
@@ -918,12 +976,16 @@ def _sharded_loop_fn(mesh, zmw_axis: str, read_axis: str,
     trace cache and re-trace the whole loop every polish."""
     from jax.sharding import PartitionSpec as P
 
-    specs = _state_specs(zmw_axis, read_axis)
+    sd = dict(statics)
+    # mesh states carry a pre-baked DenseLayout exactly when the dense
+    # scoring path is on (batch._loop_state uses the same gate)
+    specs = _state_specs(zmw_axis, read_axis,
+                         with_layout=sd.get("dense", False))
     zr, z = P(zmw_axis, read_axis), P(zmw_axis)
     from pbccs_tpu.parallel.mesh import shard_map
 
     f = functools.partial(run_refine_loop.__wrapped__,
-                          axis=(zmw_axis, read_axis), **dict(statics))
+                          axis=(zmw_axis, read_axis), **sd)
     return jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(specs, zr, zr, zr, z, zr),
@@ -934,12 +996,14 @@ def _sharded_loop_fn(mesh, zmw_axis: str, read_axis: str,
 def _sharded_qv_fn(mesh, zmw_axis: str, read_axis: str, statics: tuple):
     from jax.sharding import PartitionSpec as P
 
-    specs = _state_specs(zmw_axis, read_axis)
+    sd = dict(statics)
+    specs = _state_specs(zmw_axis, read_axis,
+                         with_layout=sd.get("dense", False))
     zr, z = P(zmw_axis, read_axis), P(zmw_axis)
     from pbccs_tpu.parallel.mesh import shard_map
 
     f = functools.partial(run_qv_ints.__wrapped__,
-                          axis=(zmw_axis, read_axis), **dict(statics))
+                          axis=(zmw_axis, read_axis), **sd)
     return jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(specs, zr, zr, zr, z, zr, z),

@@ -466,10 +466,36 @@ def process_chunk(chunk: Chunk, settings: ConsensusSettings | None = None
     return polish_prepared(prep, settings)
 
 
+def _polish_tasks(preps: Sequence[PreparedZmw]) -> list:
+    """The ZmwTask batch of a prepared ZMW sequence (ONE construction
+    shared by the inline dispatch and the prepare-side prebake)."""
+    from pbccs_tpu.parallel.batch import ZmwTask
+
+    return [ZmwTask(p.chunk.id, p.css, np.asarray(p.chunk.snr),
+                    [m.seq for m in p.mapped],
+                    [m.strand for m in p.mapped],
+                    [m.tpl_start for m in p.mapped],
+                    [m.tpl_end for m in p.mapped]) for p in preps]
+
+
+def prebake_polish(preps: Sequence[PreparedZmw], *,
+                   buckets: tuple[int, int, int] | None = None,
+                   min_z: int = 1):
+    """Pre-bake a prepared batch's device inputs on the PREPARE side:
+    build the ZmwTask batch and its bucket-shaped numpy marshalling
+    (parallel.batch.premarshal -- padded planes + f64 SNR transition
+    tables).  The sched/ prepare workers run this so the device executor
+    thread's BatchPolisher adopts arrays instead of re-deriving them;
+    pass the result to polish_prepared_batch(prebaked=...)."""
+    from pbccs_tpu.parallel.batch import premarshal
+
+    return premarshal(_polish_tasks(preps), buckets=buckets, min_z=min_z)
+
+
 def _polish_batch_arrow(preps: Sequence[PreparedZmw],
                         settings: ConsensusSettings, *,
                         buckets: tuple[int, int, int] | None = None,
-                        min_z: int = 1
+                        min_z: int = 1, prebaked=None
                         ) -> list[tuple[Failure, ConsensusResult | None]]:
     """One lockstep BatchPolisher dispatch over `preps`: the raw Arrow
     device path, outcomes ALIGNED with `preps`.  Raises on any batch-path
@@ -478,16 +504,13 @@ def _polish_batch_arrow(preps: Sequence[PreparedZmw],
     from pbccs_tpu.runtime import timing
 
     t0 = time.monotonic()
-    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+    from pbccs_tpu.parallel.batch import BatchPolisher
 
-    tasks = [ZmwTask(p.chunk.id, p.css, np.asarray(p.chunk.snr),
-                     [m.seq for m in p.mapped],
-                     [m.strand for m in p.mapped],
-                     [m.tpl_start for m in p.mapped],
-                     [m.tpl_end for m in p.mapped]) for p in preps]
+    tasks = prebaked.tasks if prebaked is not None else _polish_tasks(preps)
     with obs_trace.span("polish.setup", zmws=len(preps)):
         polisher = BatchPolisher(tasks, min_zscore=settings.min_zscore,
-                                 buckets=buckets, min_z=min_z)
+                                 buckets=buckets, min_z=min_z,
+                                 prebaked=prebaked)
     gate_info = []
     for z, p in enumerate(preps):
         gate_info.append(_read_gates(p, polisher.statuses[z], settings))
@@ -657,7 +680,7 @@ def _pinned_batch_shapes(preps: Sequence[PreparedZmw],
 def _guarded_dispatch(preps: Sequence[PreparedZmw],
                       settings: ConsensusSettings, *,
                       buckets: tuple[int, int, int] | None,
-                      min_z: int
+                      min_z: int, prebaked=None
                       ) -> list[tuple[Failure, ConsensusResult | None]]:
     """One fault-domain batch dispatch: the chaos fault site
     ("polish.dispatch", keyed by ZMW ids so poison specs can target one
@@ -674,7 +697,7 @@ def _guarded_dispatch(preps: Sequence[PreparedZmw],
         # delay exercises exactly the hung-dispatch recovery path
         faults.maybe_fail("polish.dispatch", keys=ids)
         return _polish_batch_arrow(preps, settings, buckets=buckets,
-                                   min_z=min_z)
+                                   min_z=min_z, prebaked=prebaked)
 
     def attempt():
         return watchdog.run_with_deadline(dispatch, site="polish.dispatch")
@@ -691,7 +714,8 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
                           buckets: tuple[int, int, int] | None = None,
                           min_z: int = 1,
                           on_error: str = "bisect",
-                          raise_device_shaped: bool = False
+                          raise_device_shaped: bool = False,
+                          prebaked=None
                           ) -> list[tuple[Failure, ConsensusResult | None]]:
     """Polish a batch of prepared ZMWs in one lockstep BatchPolisher and
     return per-ZMW outcomes ALIGNED with `preps` -- the polish core shared
@@ -723,7 +747,12 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
     the DevicePool strike/bench it and requeue the WHOLE batch to a
     healthy device.  Injected poison-ZMW faults (resilience.faults
     InjectedFault at polish.dispatch) are task-shaped and always stay on
-    the quarantine path."""
+    the quarantine path.
+
+    `prebaked`: a PrebakedBatch from prebake_polish (built on a prepare
+    worker) adopted by the full-batch dispatch only -- quarantine
+    sub-dispatches and serial rescues always re-marshal their own
+    subsets, so fault recovery is unchanged."""
     settings = settings or ConsensusSettings()
     if settings.model == "quiver":
         # Quiver has no lockstep batch driver: it polishes per ZMW (its
@@ -738,7 +767,7 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
         return out
     try:
         return _guarded_dispatch(preps, settings, buckets=buckets,
-                                 min_z=min_z)
+                                 min_z=min_z, prebaked=prebaked)
     except Exception as e:  # noqa: BLE001 -- quarantine the poison
         from pbccs_tpu.resilience import quarantine, retry, watchdog
 

@@ -113,6 +113,21 @@ class ScheduledPipeline:
                 (imax, jmax, r), z = pipeline._pinned_batch_shapes(
                     preps, None, 1)
                 key = (jmax, imax, r, z)
+                # pre-bake the polish marshalling HERE, on the prepare
+                # worker: padded numpy planes + f64 SNR tables build while
+                # the device threads polish earlier batches, so
+                # BatchPolisher on the executor thread adopts arrays
+                # instead of marshalling.  Quiver polishes per ZMW and
+                # never reads a prebake; any prebake failure falls back
+                # to inline marshalling (accounted, never fatal).
+                prebaked = None
+                if self.settings.model != "quiver":
+                    try:
+                        prebaked = pipeline.prebake_polish(preps)
+                    except Exception as e:  # noqa: BLE001 -- inline fallback
+                        pipeline.record_zmw_failure(
+                            "prepare.prebake", e,
+                            zmw=f"batch[{len(preps)}]")
                 settings, on_error = self.settings, self.on_error
                 fleet = self.pool.n_devices > 1
                 attempts = [0]
@@ -130,7 +145,8 @@ class ScheduledPipeline:
                     with obs_trace.span("polish", zmws=len(preps)):
                         return pipeline.polish_prepared_batch(
                             preps, settings, on_error=on_error,
-                            raise_device_shaped=fleet and attempts[0] == 1)
+                            raise_device_shaped=fleet and attempts[0] == 1,
+                            prebaked=prebaked)
 
                 self.pool.submit(
                     key, polish, zmws=len(preps),

@@ -60,7 +60,7 @@ def test_every_ast_rule_has_fixtures():
     """Adding a rule without fixtures fails here (the DESIGN.md 'how to
     add a rule' contract)."""
     constructed = {"REG001", "REG002", "REG003", "REG004", "REG005",
-                   "ANA001"}
+                   "REG006", "REG007", "ANA001"}
     missing = set(RULES) - set(AST_CASES) - constructed
     assert not missing, f"rules without fixture coverage: {missing}"
 
@@ -88,11 +88,14 @@ def _mini_repo(tmp_path: pathlib.Path) -> pathlib.Path:
     pkg.mkdir()
     (pkg / "mod.py").write_text(textwrap.dedent("""\
         import argparse
+        import os
 
 
         def setup(reg, faults):
             reg.counter("ccs_real_total", "a real metric")
             faults.maybe_fail("real.site")
+            if os.environ.get("PBCCS_REAL_TOGGLE"):
+                pass
             p = argparse.ArgumentParser()
             p.add_argument("--real")
             return p
@@ -111,6 +114,11 @@ def _mini_repo(tmp_path: pathlib.Path) -> pathlib.Path:
         |---|---|---|
         | `ghost.site` | maybe_fail() | `gone.py` |
         <!-- ccs-analyze:fault-sites-table:end -->
+        <!-- ccs-analyze:env-table:begin -->
+        | env toggle | purpose | source |
+        |---|---|---|
+        | `PBCCS_GHOST_TOGGLE` | gone | `gone.py` |
+        <!-- ccs-analyze:env-table:end -->
     """))
     (tmp_path / "README.md").write_text(
         "Run with `--real` or the removed `--ghost`.\n")
@@ -130,6 +138,10 @@ def test_registry_drift_rules(tmp_path):
     # --real is defined: must not be reported
     assert all("--real " not in f.message
                for f in found.values() if f.rule == "REG005")
+    assert "REG006" in found        # PBCCS_REAL_TOGGLE not in the table
+    assert "PBCCS_REAL_TOGGLE" in found["REG006"].message
+    assert "REG007" in found        # PBCCS_GHOST_TOGGLE only in the table
+    assert "PBCCS_GHOST_TOGGLE" in found["REG007"].message
 
 
 def test_registry_green_when_tables_match(tmp_path):
@@ -141,10 +153,37 @@ def test_registry_green_when_tables_match(tmp_path):
         <!-- ccs-analyze:fault-sites-table:begin -->
         | `real.site` | maybe_fail() | `pbccs_tpu/mod.py` |
         <!-- ccs-analyze:fault-sites-table:end -->
+        <!-- ccs-analyze:env-table:begin -->
+        | `PBCCS_REAL_TOGGLE` | a real toggle | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:env-table:end -->
     """))
     (root / "README.md").write_text("Run with `--real`.\n")
     assert [f for f in run_passes(root)
             if f.rule.startswith("REG")] == []
+
+
+def test_env_toggle_read_forms_and_scope(tmp_path):
+    """REG006 catches every read form (environ.get / environ[...] /
+    environ.setdefault / os.getenv) and ONLY PBCCS_* names -- generic
+    env reads (JAX_PLATFORMS, XLA_FLAGS...) are not ours to inventory."""
+    root = _mini_repo(tmp_path)
+    (root / "pbccs_tpu" / "envs.py").write_text(textwrap.dedent("""\
+        import os
+
+
+        def toggles():
+            a = os.environ.get("PBCCS_FORM_GET")
+            b = os.environ["PBCCS_FORM_SUBSCRIPT"]
+            c = os.environ.setdefault("PBCCS_FORM_SETDEFAULT", "0")
+            d = os.getenv("PBCCS_FORM_GETENV")
+            e = os.environ.get("JAX_PLATFORMS")     # not ours
+            return a, b, c, d, e
+    """))
+    msgs = [f.message for f in run_passes(root) if f.rule == "REG006"]
+    for name in ("PBCCS_FORM_GET", "PBCCS_FORM_SUBSCRIPT",
+                 "PBCCS_FORM_SETDEFAULT", "PBCCS_FORM_GETENV"):
+        assert any(name in m for m in msgs), (name, msgs)
+    assert not any("JAX_PLATFORMS" in m for m in msgs)
 
 
 def test_metric_kind_mismatch_is_drift(tmp_path):

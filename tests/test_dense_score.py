@@ -364,6 +364,258 @@ def test_band_read_windows_flat_offset_garbage_lane(rng):
     assert checked > 400, "test exercised too few consumed slots"
 
 
+def test_prepared_layout_matches_ingraph(rng):
+    """Pre-baked DenseLayout path == in-graph derivation, BITWISE: the
+    interior kernel and the edge programs launched on
+    prepare_dense_layout buffers must produce exactly the scores the
+    default (derive-inside-the-score-graph) path produces -- the pre-bake
+    moves work between graphs, it must not change a ULP."""
+    case = _setup_case(rng, 60, 2, [(0, 0, 60), (1, 3, 58), (0, 5, 56)])
+    R = case["reads"].shape[0]
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    args = (case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], tables, case["alpha"],
+            case["beta"], case["apre"], case["bsuf"], W)
+
+    layout = dsp.prepare_dense_layout(*args)
+    got_int = np.asarray(dsp.dense_interior_scores_batch(
+        *args, layout=layout))
+    want_int = np.asarray(dsp.dense_interior_scores_batch(*args))
+    np.testing.assert_array_equal(got_int, want_int)
+
+    ptrans = jax.vmap(dsp.dense_patch_grids)(
+        case["win_tpl"].astype(jnp.int32), case["win_trans"], tables,
+        case["wlens"])
+    edge_args = (case["reads"], case["rlens"], case["win_tpl"],
+                 case["win_trans"], case["wlens"], case["alpha"],
+                 case["beta"], case["apre"], case["bsuf"])
+    got_e = np.asarray(dsp.edge_window_scores_batch(
+        *edge_args, None, W, layout=layout))
+    want_e = np.asarray(dsp.edge_window_scores_batch(
+        *edge_args, ptrans, W))
+    np.testing.assert_array_equal(got_e, want_e)
+    # the recovered patch plane is the one that was baked
+    np.testing.assert_array_equal(
+        np.asarray(dsp.layout_ptrans(layout, int(case["win_tpl"].shape[1]))),
+        np.asarray(ptrans))
+
+
+def test_dense_scores_match_dense_oracle_prebaked(rng):
+    """Pre-baked-path interior scores vs the float64 DENSE oracle
+    (ops/fwdbwd_ref): with W >= I + 1 the band covers the whole matrix,
+    so the kernel's absolute mutated-window log-likelihood must equal
+    loglik_dense of the mutated window to f32 rounding.  Runs the
+    LAYOUT path end to end (prepare_dense_layout -> kernel), so the
+    oracle pins the baked buffers, not just their equivalence to the
+    in-graph ones."""
+    from pbccs_tpu.models.arrow import mutations as mutlib
+    from pbccs_tpu.ops.fwdbwd_ref import loglik_dense
+
+    Wo = 32
+    case = _setup_case_w(rng, 24, 2, [(0, 0, 22), (1, 0, 22)], Wo)
+    R = case["reads"].shape[0]
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    args = (case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], tables, case["alpha"],
+            case["beta"], case["apre"], case["bsuf"], Wo)
+    layout = dsp.prepare_dense_layout(*args)
+    grid_w = np.asarray(dsp.dense_interior_scores_batch(
+        *args, layout=layout))
+
+    checked = 0
+    for r in range(R):
+        J = int(case["wlens"][r])
+        I = int(case["rlens"][r])
+        assert Wo >= I + 1, "oracle regime needs a full-cover band"
+        wt = np.asarray(case["win_tpl"][r])[:J].astype(np.int8)
+        read = np.asarray(case["reads"][r])[:I].astype(np.int8)
+        for p in range(3, J - 2, 3):
+            for k in (0, 2, 4, 8):          # sub A, sub G, ins A, del
+                mtype = [0, 0, 0, 0, 1, 1, 1, 1, 2][k]
+                nbase = [0, 1, 2, 3, 0, 1, 2, 3, -1][k]
+                end = p + (0 if mtype == 1 else 1)
+                if end > J - 2:             # interior contract
+                    continue
+                if mtype == 0 and wt[p] == nbase:
+                    continue                # not a real mutation slot
+                mut = mutlib.Mutation(start=p, end=end, mtype=mtype,
+                                      new_base=max(nbase, 0))
+                mtpl = mutlib.apply_mutations(wt, [mut])
+                table_j = case["table"]
+                from pbccs_tpu.models.arrow.params import \
+                    template_transition_params
+                mtr = np.asarray(template_transition_params(
+                    jnp.asarray(mtpl.astype(np.int32)), table_j,
+                    jnp.int32(len(mtpl))), np.float64)[: len(mtpl)]
+                want = loglik_dense(read, mtpl, mtr)
+                got = float(grid_w[r, p, k])
+                np.testing.assert_allclose(
+                    got, want, rtol=5e-5, atol=5e-3,
+                    err_msg=f"read {r} p={p} k={k}")
+                checked += 1
+    assert checked > 20, "oracle comparison exercised too few slots"
+
+
+def _setup_case_w(rng, L, n_reads, windows, width):
+    """_setup_case at an explicit band width (module W is the default)."""
+    global W
+    saved = W
+    try:
+        W = width
+        return _setup_case(rng, L, n_reads, windows)
+    finally:
+        W = saved
+
+
+def test_band_read_windows_prebake_equivalence(rng):
+    """band_read_windows pre-bake at a NON-TRIVIAL offset pattern: with
+    a synthetic monotone staircase band (mixed advances of 0/1/3 rows
+    per column -- the shape guided rebanding produces), the pre-baked
+    (rw_base, rw_next) pair must (a) be served verbatim by the layout,
+    (b) equal a direct numpy model of the circular windows on every
+    in-band lane, and (c) feed the kernel identically to the in-graph
+    derivation."""
+    case = _setup_case(rng, 60, 2, [(0, 0, 60), (1, 0, 60)])
+    R = case["reads"].shape[0]
+    nc = case["alpha"].offsets.shape[1]
+    I = np.asarray(case["rlens"])
+
+    # staircase offsets: advance 0/1/3 in a repeating pattern, clipped
+    # to the legal [0, I+1-W] range (monotone, slope <= MAX_BAND_ADVANCE)
+    steps = np.tile(np.array([0, 1, 3, 0, 1], np.int32), nc // 5 + 1)[:nc]
+    offs = np.cumsum(steps)[None, :].repeat(R, 0)
+    offs = np.minimum(offs, np.maximum(I[:, None] + 1 - W, 0)).astype(np.int32)
+    offsets = jnp.asarray(offs)
+
+    rbase, rnext = dsp.band_read_windows(case["reads"], offsets, W)
+    rbase, rnext = np.asarray(rbase), np.asarray(rnext)
+
+    # numpy model: rnext[r, j, L] = read_pad0[row] for the unique row in
+    # [o, o+W) with row % W == L (0 past the read end)
+    read_f = np.asarray(case["reads"]).astype(np.float32)
+    for r in range(R):
+        pad0 = np.concatenate([read_f[r], np.zeros(W, np.float32)])
+        pad1 = np.concatenate([[read_f[r][0]], read_f[r],
+                               np.zeros(W, np.float32)])
+        for j in (0, 1, nc // 3, nc // 2, nc - 1):
+            o = int(offs[r, j])
+            q = o % W
+            rows = o - q + np.arange(W) + np.where(np.arange(W) < q, W, 0)
+            np.testing.assert_array_equal(
+                rnext[r, j], pad0[np.minimum(rows, len(pad0) - 1)]
+                * (rows < len(read_f[r]) + W),
+                err_msg=f"rnext r={r} j={j}")
+            # rbase non-cut lanes hold read_pad1[row] (= read_pad0[row-1])
+            ok = np.arange(W) != q
+            got = rbase[r, j][ok]
+            want = pad1[np.minimum(rows, len(pad1) - 1)][ok]
+            in_rng = rows[ok] < len(read_f[r]) + W
+            np.testing.assert_array_equal(got * in_rng, want * in_rng,
+                                          err_msg=f"rbase r={r} j={j}")
+
+    # the layout serves the SAME pair, and the kernel consumes it
+    # identically to the in-graph derivation
+    alpha = BandedMatrix(case["alpha"].vals, offsets,
+                         case["alpha"].log_scales)
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    args = (case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], tables, alpha,
+            case["beta"], case["apre"], case["bsuf"], W)
+    layout = dsp.prepare_dense_layout(*args)
+    np.testing.assert_array_equal(np.asarray(layout.rw_base), rbase)
+    np.testing.assert_array_equal(np.asarray(layout.rw_next), rnext)
+    np.testing.assert_array_equal(
+        np.asarray(dsp.dense_interior_scores_batch(*args, layout=layout)),
+        np.asarray(dsp.dense_interior_scores_batch(*args)))
+
+
+def test_multi_column_blocking_parity(rng, monkeypatch):
+    """PBCCS_DENSE_CB in {1, 2, 3} produces identical scores on a
+    multi-block template (Jm spans several _PB sub-blocks), including a
+    sparse live mask -- sub-block liveness granularity must survive the
+    grouping.  The env is read at trace time, so each setting clears the
+    jit cache first (same caveat as PBCCS_PALLAS)."""
+    case = _setup_case(rng, 150, 2, [(0, 0, 150), (1, 5, 140)])
+    R = case["reads"].shape[0]
+    tables = jnp.broadcast_to(case["table"][None], (R, 8, 4))
+    Jm = int(case["win_tpl"].shape[1])
+    NB = -(-Jm // dsp._PB)
+    assert NB >= 2, "case must span several position sub-blocks"
+    live = np.zeros((R, NB), bool)
+    live[:, 0] = True            # sparse: only the first sub-block live
+    live[0, -1] = True
+    args = (case["reads"], case["rlens"], case["win_tpl"],
+            case["win_trans"], case["wlens"], tables, case["alpha"],
+            case["beta"], case["apre"], case["bsuf"], W)
+
+    outs = {}
+    for cb in (1, 2, 3):
+        monkeypatch.setenv("PBCCS_DENSE_CB", str(cb))
+        dsp.dense_interior_scores_batch.clear_cache()
+        dsp.prepare_dense_layout.clear_cache()
+        layout = dsp.prepare_dense_layout(*args)
+        outs[cb] = (
+            np.asarray(dsp.dense_interior_scores_batch(*args)),
+            np.asarray(dsp.dense_interior_scores_batch(
+                *args, live=jnp.asarray(live), layout=layout)),
+        )
+    for cb in (2, 3):
+        np.testing.assert_array_equal(outs[cb][0], outs[1][0])
+        np.testing.assert_array_equal(outs[cb][1], outs[1][1])
+    # dead sub-blocks really are zero, live ones really are not
+    full, masked = outs[1]
+    assert np.array_equal(masked[1, : dsp._PB], full[1, : dsp._PB])
+    assert not masked[1, dsp._PB: 2 * dsp._PB].any()
+
+    # whole-row mode composes with multi-column blocking (the kernel's
+    # base offset comes from the live value, not the sub-block index)
+    monkeypatch.setenv("PBCCS_WHOLE_ROW", "1")
+    monkeypatch.setenv("PBCCS_DENSE_CB", "2")
+    dsp.dense_interior_scores_batch.clear_cache()
+    dsp.prepare_dense_layout.clear_cache()
+    wr = np.asarray(dsp.dense_interior_scores_batch(
+        *args, live=jnp.asarray(live)))
+    np.testing.assert_array_equal(wr, outs[1][1])
+
+
+@pytest.mark.slow
+def test_refine_device_dense_with_layout_e2e(monkeypatch):
+    """Full device-resident refinement with the dense path ON (so the
+    loop state carries a pre-baked DenseLayout, rebuild refreshes it,
+    and the eager QV sweep consumes it): an easy 2-ZMW draw must
+    converge and recover the true templates end to end, pinning the
+    lax.cond rebuild/carry plumbing the layout rides through.
+
+    Seed 1234, not the shared fixture: on the fixture draw the dense
+    path accepts one spurious near-end insert on ZMW 1 (a pre-existing
+    f32 association-order property of the dense scorer, identical
+    before and after the layout pre-bake -- verified bit-for-bit against
+    the pre-round-6 tree), and this test pins the NEW plumbing, not that
+    old knife-edge."""
+    from pbccs_tpu.models.arrow.refine import RefineOptions
+    from pbccs_tpu.parallel.batch import BatchPolisher, ZmwTask
+
+    monkeypatch.setenv("PBCCS_DENSE", "1")
+    rng = np.random.default_rng(1234)
+    tasks, truths = [], []
+    for z in range(2):
+        tpl, reads, strands, snr = simulate_zmw(rng, 60, 6)
+        draft = tpl.copy()
+        draft[20 + 7 * z] = (draft[20 + 7 * z] + 1) % 4
+        tasks.append(ZmwTask(f"dl/{z}", draft, snr, reads, strands,
+                             [0] * 6, [len(draft)] * 6))
+        truths.append(tpl)
+    p = BatchPolisher(tasks)
+    st = p._loop_state(set())
+    assert st.dlayout is not None, "dense path must pre-bake the layout"
+    results = p.refine_device(RefineOptions(max_iterations=10))
+    assert results is not None and all(r.converged for r in results)
+    for z in range(2):
+        np.testing.assert_array_equal(p.tpls[z], truths[z])
+    qvs = p.consensus_qvs()
+    assert all(len(q) == len(p.tpls[z]) for z, q in enumerate(qvs))
+
+
 def test_dense_patch_grids_match_make_patches(rng):
     """Window-frame patch planes equal make_patches_fast on the grid."""
     tpl, _, _, snr = simulate_zmw(rng, 50, 3)

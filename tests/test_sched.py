@@ -402,7 +402,8 @@ def test_executor_first_attempt_device_failure_reaches_pool(monkeypatch):
     flags = []
 
     def fake_polish(preps, settings, *, buckets=None, min_z=1,
-                    on_error="bisect", raise_device_shaped=False):
+                    on_error="bisect", raise_device_shaped=False,
+                    prebaked=None):
         flags.append(raise_device_shaped)
         if len(flags) == 1:
             raise FakeXla("device fell over")

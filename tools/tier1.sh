@@ -23,6 +23,10 @@ else
     echo "ruff not installed; skipping (CI installs and enforces it)"
 fi
 
+echo "== kernel smoke (dense interior + edge kernels vs the f64 dense oracle) =="
+# interpret mode, fixed seed, prebaked-layout path; ~30 s budget
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/kernel_smoke.py || exit 1
+
 echo "== observability smoke (trace schema) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 

@@ -76,6 +76,47 @@ def parse(outdir: str):
     return paths[0], rows
 
 
+# PROFILE_r0N region buckets: keyword -> region, FIRST match wins (order
+# matters: "dynamic-slice" must hit before "slice").  Shared by this
+# tool's rollup and bench.py's per-row device_regions_ms attribution.
+_REGION_KEYS = [
+    ("kernels", ("custom-call", "custom call", "mosaic", "pallas")),
+    ("dynamic_slice", ("dynamic-slice", "dynamic slice",
+                       "dynamic-update-slice", "gather", "scatter")),
+    ("data_formatting", ("copy", "transpose", "concatenate", "convert",
+                         "reshape", "bitcast")),
+    ("slice_pad", ("slice", "pad")),
+    ("fusion", ("fusion", "loop", "while", "conditional")),
+]
+
+
+def region_rollup(rows) -> dict:
+    """Collapse hlo_stats rows into the PROFILE region buckets.
+
+    Returns {"total_ms", "kernel_fraction", "regions": {region: ms}} --
+    the per-BENCH-row attribution that makes kernel-share regressions
+    visible round over round (a polish whose kernel_fraction drops is
+    re-growing the layout/pad overhead this round removed)."""
+    per = {name: 0.0 for name, _ in _REGION_KEYS}
+    per["other"] = 0.0
+    for r in rows:
+        hay = " ".join((r.get("category") or "",
+                        r.get("name") or "",
+                        r.get("frame_op") or "")).lower()
+        for name, keys in _REGION_KEYS:
+            if any(k in hay for k in keys):
+                per[name] += r["self_us"]
+                break
+        else:
+            per["other"] += r["self_us"]
+    total = sum(per.values())
+    return {
+        "total_ms": round(total / 1e3, 1),
+        "kernel_fraction": round(per["kernels"] / total, 4) if total else 0.0,
+        "regions": {k: round(v / 1e3, 1) for k, v in per.items()},
+    }
+
+
 def main():
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/pbccs_trace"
     if not os.environ.get("PBCCS_TRACE_PARSE_ONLY"):
@@ -102,6 +143,7 @@ def main():
               file=sys.stderr)
     print(json.dumps({
         "total_device_ms": round(total / 1e3, 1),
+        "region_rollup": region_rollup(rows),
         "categories": {k: round(v / 1e3, 1) for k, v in rollup},
         "top_ops": [{"name": (r["frame_op"] or r["name"])[:160],
                      "category": r["category"],
