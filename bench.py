@@ -707,6 +707,243 @@ def bench_sched() -> dict:
                        f"{proc.stderr[-500:]}")
 
 
+def _spawn_serve_replica(cache_dir: str, extra_args: list[str]
+                         | None = None):
+    """One `ccs serve` subprocess on an ephemeral port (CPU platform:
+    N replicas cannot share one accelerator); returns (proc, port)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbccs_tpu.cli", "serve", "--port", "0",
+         "--compileCache", cache_dir,
+         # router-fronted replicas: one multiplexed session carries the
+         # whole fleet's traffic, so the per-session cap must match the
+         # admission bound (see DESIGN.md Fleet serving)
+         "--maxInflightPerSession", "256", "--logLevel", "ERROR"]
+        + (extra_args or []),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    line = proc.stdout.readline()
+    while line and not line.startswith("CCS-SERVE-READY"):
+        line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError(f"replica never became ready (rc={proc.poll()})")
+    return proc, int(line.split()[2])
+
+
+def _drive_router(host: str, port: int, zmws: list[dict], sessions: int,
+                  window: int) -> tuple[float, list[float], list[str]]:
+    """Submit the workload through `sessions` concurrent clients, each
+    holding at most `window` requests in flight; returns (wall_s,
+    per-request latency ms, errors).  Errors are collected rather than
+    killing the worker thread: a partially-driven level must be visibly
+    degraded, never silently published as a clean row."""
+    import threading
+
+    from pbccs_tpu.serve.client import CcsClient, ServeError
+
+    shares = [zmws[i::sessions] for i in range(sessions)]
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def one(share):
+        with CcsClient(host, port) as cli:
+            pending = []
+
+            def reap():
+                z, t0, h = pending.pop(0)
+                try:
+                    h.reply(timeout=600.0)
+                except (ServeError, ConnectionError, TimeoutError) as e:
+                    with lock:
+                        errors.append(f"{z['id']}: {e}")
+                    return
+                with lock:
+                    latencies.append((time.monotonic() - t0) * 1e3)
+
+            for z in share:
+                if len(pending) >= window:
+                    reap()
+                try:
+                    pending.append((z, time.monotonic(),
+                                    cli.submit_wire(z)))
+                except ConnectionError as e:
+                    with lock:
+                        errors.append(f"{z['id']}: {e}")
+            while pending:
+                reap()
+
+    threads = [threading.Thread(target=one, args=(s,))
+               for s in shares if s]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0, latencies, errors
+
+
+def bench_router() -> dict:
+    """Multi-replica serve fleet: throughput 1 -> N replicas behind
+    `ccs router`, with a sessions x in-flight saturation ramp per fleet
+    size (the in-flight window doubles until p99 breaks the SLO or the
+    workload is fully in flight).  Replicas are real `ccs serve`
+    subprocesses pinned to CPU sharing one --compileCache dir, so the
+    scaling figure is a lower bound for a real one-accelerator-per-
+    replica fleet (subprocesses share the host cores)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.serve.router import CcsRouter, RouterConfig, RouterServer
+    from pbccs_tpu.simulate import simulate_zmw
+
+    n_replicas = int(os.environ.get("BENCH_ROUTER_REPLICAS", 3))
+    n_zmws = int(os.environ.get("BENCH_ROUTER_ZMWS", 48))
+    tpl_len = int(os.environ.get("BENCH_ROUTER_TPL_LEN", 120))
+    passes = int(os.environ.get("BENCH_ROUTER_PASSES", 6))
+    sessions = int(os.environ.get("BENCH_ROUTER_SESSIONS", 4))
+    slo_ms = float(os.environ.get("BENCH_ROUTER_SLO_MS", 60_000))
+    max_batch = int(os.environ.get("BENCH_ROUTER_MAX_BATCH", 8))
+
+    rng = np.random.default_rng(20260803)
+    zmws = []
+    for i in range(n_zmws):
+        _, reads, _, snr = simulate_zmw(rng, tpl_len, passes)
+        zmws.append({"id": f"rb/{i}", "snr": [float(s) for s in snr],
+                     "reads": [{"seq": decode_bases(r)} for r in reads]})
+
+    cache_dir = tempfile.mkdtemp(prefix="pbccs_router_cache_")
+    procs = []
+    try:
+        ports = []
+        for _ in range(n_replicas):
+            proc, port = _spawn_serve_replica(
+                cache_dir, ["--maxBatch", str(max_batch)])
+            procs.append(proc)
+            ports.append(port)
+        # warm every replica at the serve bucket shapes before timing (the
+        # first replica pays the compile, the rest load it from the shared
+        # --compileCache): a cold compile inside a timed ramp level would
+        # masquerade as saturation
+        for port in ports:
+            _drive_router("127.0.0.1", port, zmws, sessions, max_batch)
+
+        rows = []
+        for r in range(1, n_replicas + 1):
+            router = CcsRouter(
+                [f"127.0.0.1:{p}" for p in ports[:r]],
+                RouterConfig(health_interval_s=1.0)).start()
+            server = RouterServer(router, port=0).start()
+            best = None
+            window = 1
+            try:
+                while True:
+                    wall, lat, errs = _drive_router(
+                        server.host, server.port, zmws, sessions, window)
+                    if errs or not lat:
+                        # degraded level (errors or nothing completed):
+                        # stop the ramp at the last CLEAN level rather
+                        # than publishing inflated partial figures
+                        log_note = {"inflight_per_session": window,
+                                    "errors": len(errs),
+                                    "error_sample": errs[:3]}
+                        if best is not None:
+                            best = dict(best, degraded_next_level=log_note)
+                        else:
+                            best = {"note": "level failed", **log_note}
+                        break
+                    lat_arr = np.asarray(lat)
+                    level = {
+                        "inflight_per_session": window,
+                        "zmws_per_sec": round(n_zmws / wall, 4),
+                        "p50_ms": round(float(np.percentile(lat_arr, 50)), 1),
+                        "p99_ms": round(float(np.percentile(lat_arr, 99)), 1),
+                    }
+                    if level["p99_ms"] > slo_ms:
+                        break  # saturated: p99 broke the SLO at this level
+                    best = level
+                    if sessions * window >= n_zmws:
+                        break  # the whole workload is already in flight
+                    window *= 2
+            finally:
+                server.shutdown()
+                router.close()
+            rows.append({"replicas": r, "sessions": sessions,
+                         **(best or {"note": "p99 broke SLO at window=1"})})
+        base = rows[0].get("zmws_per_sec")
+        return {
+            "name": "serve_router_fleet",
+            "n_zmws": n_zmws, "tpl_len": tpl_len, "n_passes": passes,
+            "max_batch": max_batch, "slo_ms": slo_ms,
+            "host_cpus": os.cpu_count(),
+            "rows": rows,
+            "speedup_vs_1replica": round(
+                rows[-1]["zmws_per_sec"] / base, 3)
+            if base and rows[-1].get("zmws_per_sec") else None,
+            "note": "CPU replica subprocesses share the host cores; "
+                    "scaling is a lower bound for a one-accelerator-"
+                    "per-replica fleet",
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def bench_warm_restart() -> dict:
+    """Rolling-restart cost with the persistent compile cache: `ccs
+    warmup --compileCache DIR` twice against a FRESH dir.  The first run
+    is the cold first-compile a cacheless replica restart would pay; the
+    second is the restarted replica loading executables from disk."""
+    import json as json_mod
+    import shutil
+    import subprocess
+    import tempfile
+
+    bucket = os.environ.get("BENCH_WARM_BUCKET", "4x3x60")
+    cache_dir = tempfile.mkdtemp(prefix="pbccs_warmcache_")
+
+    def once() -> tuple[float, float]:
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pbccs_tpu.cli", "warmup",
+             "--bucket", bucket, "--compileCache", cache_dir,
+             "--logLevel", "ERROR"],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            timeout=float(os.environ.get("BENCH_WARM_TIMEOUT", 1800)))
+        wall = time.monotonic() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"warmup rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}")
+        report = json_mod.loads(proc.stdout.splitlines()[-1])
+        return wall, sum(e["seconds"] for e in report["warmed"])
+
+    try:
+        cold_wall, cold_s = once()
+        warm_wall, warm_s = once()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return {
+        "name": "serve_warm_restart", "bucket": bucket,
+        "cold_compile_s": round(cold_s, 2),
+        "warm_compile_s": round(warm_s, 2),
+        "cold_wall_s": round(cold_wall, 2),
+        "warm_wall_s": round(warm_wall, 2),
+        "compile_speedup": round(cold_s / warm_s, 1) if warm_s else None,
+        "note": "warmup subprocess against a fresh --compileCache dir; "
+                "warm run = a rolling replica restart's startup cost",
+    }
+
+
 def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
                    n_passes: str = "8", n_corr: int = 2,
                    chunk: int = 128) -> dict:
@@ -831,7 +1068,8 @@ def main() -> None:
             with open(BASELINE_FILE) as f:
                 ref_cfgs = json.load(f).get("configs", {})
         configs = bench_sweep(ref_cfgs)
-        for extra in (bench_quiver, bench_streamed, bench_sched):
+        for extra in (bench_quiver, bench_streamed, bench_sched,
+                      bench_router, bench_warm_restart):
             try:
                 configs.append(extra())
             except Exception as e:  # noqa: BLE001

@@ -123,7 +123,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ccs", description=DESCRIPTION,
         epilog="`ccs serve [OPTIONS]` starts the long-lived online serving "
-               "engine instead (see `ccs serve --help`).")
+               "engine instead, and `ccs router [OPTIONS]` the "
+               "multi-replica front door over N serve processes (see "
+               "`ccs serve --help` / `ccs router --help`).")
     p.add_argument("--version", action="version", version=__version__)
     p.add_argument("--zmws", default="all",
                    help="ZMWs to process: all, or ranges like 1-3,5 or "
@@ -338,6 +340,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.serve.server import run_serve
 
         return run_serve(argv[1:])
+    if argv and argv[0] == "router":
+        # `ccs router`: multi-replica front door (pbccs_tpu/serve/router)
+        from pbccs_tpu.serve.router import run_router
+
+        return run_router(argv[1:])
     if argv and argv[0] == "warmup":
         # `ccs warmup`: precompile a declared bucket menu (pbccs_tpu/sched)
         from pbccs_tpu.sched.warmup import run_warmup

@@ -51,12 +51,20 @@ def _install_cache_metrics() -> None:
         pass
 
 
-def enable_compilation_cache() -> str:
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (the
+    `--compileCache` flag), else an environment/config-provided dir,
+    else the checkout-local / per-user default.  An explicit dir is the
+    fleet-restart contract: every `ccs serve` replica and `ccs warmup`
+    pointed at the same directory shares one executable store, so a
+    rolling replica restart pays a disk load (seconds) instead of the
+    first-run XLA compile (~a minute per bucket shape)."""
     import jax
 
     _install_cache_metrics()
 
-    configured = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+    configured = cache_dir or \
+        os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
         jax.config.jax_compilation_cache_dir
     if configured:
         cache_dir = configured

@@ -3,11 +3,18 @@ batch CLI (`--devices`) and the serve engine (`ServeConfig.devices`).
 
   * pool.py      DevicePool / per-device executor threads, sticky bucket
                  routing, health-based benching + requeue
+  * health.py    StickyMap + HealthTracker: the routing/benching idioms
+                 shared with the serve router (replica granularity)
   * executor.py  ScheduledPipeline: host prepare pool overlapped with
                  in-flight device polishes, ordered result emission
   * warmup.py    `ccs warmup`: precompile a declared bucket menu
 """
 
+from pbccs_tpu.sched.health import (  # noqa: F401
+    HealthPolicy,
+    HealthTracker,
+    StickyMap,
+)
 from pbccs_tpu.sched.pool import (  # noqa: F401
     DevicePool,
     DevicePoolConfig,

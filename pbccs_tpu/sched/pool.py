@@ -67,6 +67,7 @@ from typing import Any, Callable, Hashable, Sequence
 
 from pbccs_tpu.obs.metrics import default_registry
 from pbccs_tpu.runtime.logging import Logger
+from pbccs_tpu.sched.health import StickyMap
 
 _reg = default_registry()
 _m_requeues = _reg.counter(
@@ -223,8 +224,9 @@ class DevicePool:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._workers = [_Worker(i, d) for i, d in enumerate(devices)]
-        # bucket key -> worker indices that have run it (sticky "homes")
-        self._homes: dict[Hashable, set[int]] = {}
+        # bucket key -> worker indices that have run it (sticky "homes";
+        # the map itself is shared with the serve router -- sched/health)
+        self._sticky = StickyMap()
         self._rr = -1
         self._closed = False
         for w in self._workers:
@@ -301,29 +303,20 @@ class DevicePool:
         # least-loaded tie-break: fewer resident buckets first (spread the
         # compiled-program menu across the fleet), then device order
         def load(w: _Worker):
-            n_buckets = sum(1 for homes in self._homes.values()
-                            if w.index in homes)
-            return (w.depth(), n_buckets, w.index)
+            return (w.depth(), self._sticky.resident_count(w.index),
+                    w.index)
 
         if policy == "sticky":
-            home_set = self._homes.get(task.key, ())
-            homes = [w for w in healthy if w.index in home_set]
-            if homes:
-                best = min(homes, key=load)
-                if best.depth() <= self.config.spill_depth:
-                    _m_sticky["home"].inc()
-                    return best
-                # a busy home can still be the least-loaded device on a
-                # saturated fleet -- that route is home, not spill
-                target = min(healthy, key=load)
-                _m_sticky["home" if target.index in home_set
-                          else "spill"].inc()
-                return target
-            _m_sticky["new"].inc()
+            target, outcome = self._sticky.route(
+                task.key, healthy, member_id=lambda w: w.index, load=load,
+                depth=lambda w: w.depth(),
+                spill_depth=self.config.spill_depth)
+            _m_sticky[outcome].inc()
+            return target
         return min(healthy, key=load)
 
     def _enqueue_locked(self, w: _Worker, task: _Task) -> None:
-        self._homes.setdefault(task.key, set()).add(w.index)
+        self._sticky.note(task.key, w.index)
         w.pending.append(task)
         w.m_depth.set(w.depth())
 
@@ -468,8 +461,7 @@ class DevicePool:
         queued = list(w.pending)
         w.pending.clear()
         w.m_depth.set(0)
-        for homes in self._homes.values():
-            homes.discard(w.index)
+        self._sticky.forget_member(w.index)
         self._log.error(
             f"sched: benching device {w.name} after {w.strikes} "
             f"device-shaped failure(s) (last: {type(exc).__name__}: {exc}); "
@@ -539,10 +531,8 @@ class DevicePool:
     def status(self) -> dict:
         """Per-device breakdown (the serve `status` verb embeds this)."""
         with self._lock:
-            bucket_count = {w.index: 0 for w in self._workers}
-            for homes in self._homes.values():
-                for i in homes:
-                    bucket_count[i] = bucket_count.get(i, 0) + 1
+            bucket_count = {w.index: self._sticky.resident_count(w.index)
+                            for w in self._workers}
             return {
                 "policy": self.config.policy,
                 "devices": [{

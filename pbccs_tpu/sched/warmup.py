@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Compile every bucket on every device (default: "
                         "one device; the persistent compilation cache "
                         "serves the rest as disk hits).")
+    p.add_argument("--compileCache", default=None, metavar="DIR",
+                   help="Persistent XLA compilation-cache directory to "
+                        "populate -- point the serve fleet's "
+                        "--compileCache at the same DIR so replica "
+                        "(re)starts load the warmed executables from "
+                        "disk (default: JAX_COMPILATION_CACHE_DIR, else "
+                        "the checkout-local .jax_cache).")
     p.add_argument("--logLevel", default="INFO")
     return p
 
@@ -110,7 +117,7 @@ def run_warmup(argv: list[str] | None = None) -> int:
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 
-    enable_compilation_cache()
+    enable_compilation_cache(args.compileCache)
 
     import jax
 

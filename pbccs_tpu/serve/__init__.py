@@ -4,7 +4,10 @@ The production-scale counterpart of the batch CLI (see docs/DESIGN.md
 "Serving"): `engine.CcsEngine` owns the device and batches concurrent
 requests; `server.CcsServer`/`client.CcsClient` speak the streaming
 protocol (`protocol`); `batcher.DynamicBatcher` is the socket-free
-scheduling core.  `ccs serve` (cli.py) is the process entry point.
+scheduling core.  `ccs serve` (cli.py) is the process entry point;
+`router.CcsRouter`/`ccs router` is the multi-replica front door
+(health-checked failover across N serve processes, docs/DESIGN.md
+"Fleet serving").
 """
 
 from pbccs_tpu.serve.batcher import Batch, DynamicBatcher, PendingItem

@@ -5,7 +5,16 @@ PendingReply immediately, a background reader thread re-associates the
 out-of-order streamed replies by request id, and `.reply()` blocks the
 caller until that request's result lands.  Thread-safe: any number of
 caller threads may share one client (the load generator runs many).
-"""
+
+Resilience: `submit_with_retry` rides out BOTH `overloaded`
+backpressure (jittered exponential backoff) and connection loss -- a
+dropped socket fails the in-flight attempt with ConnectionError, the
+next attempt reconnects to the same endpoint and RESUBMITS the payload
+under a fresh request id (an unacknowledged submit is the client's to
+replay; the server/router side dedups nothing because a new id is a new
+request and polish is pure).  Every attempt cleans up after itself: a
+reply that never came (timeout, exhaustion) discards its pending handle,
+so no id dangles in the reply map holding a session in-flight slot."""
 
 from __future__ import annotations
 
@@ -37,6 +46,7 @@ class PendingReply:
         self.request_id = request_id
         self._event = threading.Event()
         self._msg: dict[str, Any] | None = None
+        self._gen = 0   # connection generation (set at registration)
 
     def _complete(self, msg: dict[str, Any]) -> None:
         self._msg = msg
@@ -62,42 +72,107 @@ class PendingReply:
 
 
 class CcsClient:
-    """NDJSON/TCP client for `ccs serve` (context-manager friendly)."""
+    """NDJSON/TCP client for `ccs serve` / `ccs router`
+    (context-manager friendly)."""
 
     def __init__(self, host: str, port: int, timeout: float | None = None):
-        self._sock = socket.create_connection((host, port), timeout=30.0)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(timeout)
+        self._host, self._port = host, port
+        self._timeout = timeout
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         self._pending: dict[str, PendingReply] = {}
         self._seq = 0
+        self._gen = 0            # bumps on every (re)connect
         self._closed = False
-        self._reader = threading.Thread(target=self._read_loop, daemon=True,
-                                        name="ccs-client-reader")
-        self._reader.start()
+        # serializes connect/reconnect (never held across a reply wait)
+        self._conn_lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._reader: threading.Thread | None = None
+        with self._conn_lock:
+            self._open_locked()
 
     # ----------------------------------------------------------- plumbing
+
+    def _open_locked(self) -> None:
+        """(Re)open the transport; caller holds _conn_lock.  Any previous
+        socket is closed DETERMINISTICALLY first (no half-open fd
+        lingers behind a failed retry loop) and its reader joined, so
+        its leftover handles fail before new ones register."""
+        old_sock, old_reader = self._sock, self._reader
+        self._sock = None
+        if old_sock is not None:
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+        if old_reader is not None:
+            old_reader.join(timeout=5.0)
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=30.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._timeout)
+        self._gen += 1
+        self._sock = sock
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock, self._gen), daemon=True,
+            name=f"ccs-client-reader-{self._gen}")
+        self._reader.start()
+
+    def _ensure_connected(self) -> None:
+        """Reconnect when the transport died (reader exited).  Used by
+        submit_with_retry between attempts; plain submits keep the
+        original fail-fast behavior."""
+        with self._conn_lock:
+            if self._closed:
+                raise ConnectionError("client is closed")
+            if (self._sock is not None and self._reader is not None
+                    and self._reader.is_alive()):
+                return
+            self._open_locked()
 
     def _next_id(self) -> str:
         with self._plock:
             self._seq += 1
             return f"r{self._seq}"
 
-    def _send(self, msg: dict[str, Any], handle: PendingReply) -> None:
+    def _discard(self, handle: PendingReply) -> None:
+        """Drop a handle whose reply will never be consumed (timeout /
+        retry exhaustion): a late reply then falls on the floor instead
+        of completing into a map nobody reads, and the map cannot grow
+        without bound under a retry loop."""
         with self._plock:
-            self._pending[handle.request_id] = handle
+            self._pending.pop(handle.request_id, None)
+
+    def _send(self, msg: dict[str, Any], handle: PendingReply) -> None:
         try:
             with self._wlock:
-                self._sock.sendall(protocol.encode_msg(msg))
+                # capture (sock, gen) and REGISTER under the write lock:
+                # registering before it with a stale generation would let
+                # a racing reconnect's leftover sweep fail this handle as
+                # __disconnected__ even though the frame then goes out on
+                # the NEW connection (_open_locked bumps _gen before
+                # publishing the new socket, so a new sock implies the
+                # matching gen here)
+                sock = self._sock
+                if sock is None:
+                    raise OSError("no connection")
+                if self._reader is not None and not self._reader.is_alive():
+                    # the transport is known dead: a sendall could still
+                    # "succeed" into the kernel buffer and park this
+                    # handle forever (no reader will ever fail it)
+                    raise OSError("connection closed")
+                with self._plock:
+                    handle._gen = self._gen
+                    self._pending[handle.request_id] = handle
+                sock.sendall(protocol.encode_msg(msg))
         except OSError as e:
             with self._plock:
                 self._pending.pop(handle.request_id, None)
             raise ConnectionError(f"send failed: {e}") from None
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
         try:
-            with self._sock.makefile("rb") as rf:
+            with sock.makefile("rb") as rf:
                 for line in rf:
                     if not line.strip():
                         continue
@@ -113,10 +188,14 @@ class CcsClient:
         except OSError:
             pass
         finally:
-            # fail whatever is still waiting so callers unblock
+            # fail whatever THIS connection still owes so callers
+            # unblock; handles registered on a newer connection (a
+            # racing reconnect) are someone else's to answer
             with self._plock:
-                leftovers = list(self._pending.values())
-                self._pending.clear()
+                leftovers = [h for h in self._pending.values()
+                             if h._gen <= gen]
+                for h in leftovers:
+                    self._pending.pop(h.request_id, None)
             for handle in leftovers:
                 handle._complete({"type": "__disconnected__",
                                   "id": handle.request_id})
@@ -152,26 +231,41 @@ class CcsClient:
                           policy: "RetryPolicy | None" = None,
                           reply_timeout: float | None = 600.0
                           ) -> dict[str, Any]:
-        """Submit one ZMW, honoring `overloaded` backpressure: an
-        overloaded rejection re-submits with jittered exponential backoff
-        (resilience.retry.OVERLOADED_RETRY by default -- bounded attempts
-        AND a wall deadline), so a client fleet sheds load instead of
-        hammering a full engine.  Blocks until the final reply; returns
-        the reply message.  Non-overloaded errors raise immediately;
+        """Submit one ZMW, riding out `overloaded` backpressure AND
+        connection loss: an overloaded rejection re-submits with
+        jittered exponential backoff (resilience.retry.OVERLOADED_RETRY
+        by default -- bounded attempts AND a wall deadline); a dropped
+        connection reconnects and resubmits the unacknowledged payload
+        under a fresh request id.  Blocks until the final reply; returns
+        the reply message.  Non-retryable errors raise immediately;
         exhausted retries raise retry.RetriesExhausted from the last
-        overloaded rejection."""
+        structured error, with no request id left dangling in the reply
+        map in any exit path."""
         from pbccs_tpu.resilience import retry as retry_mod
 
         policy = policy or retry_mod.OVERLOADED_RETRY
         wire = protocol.chunk_to_wire(zmw) if isinstance(zmw, Chunk) else zmw
 
         def attempt() -> dict[str, Any]:
-            return self.submit_wire(wire, deadline_ms).reply(reply_timeout)
+            self._ensure_connected()
+            handle = self.submit_wire(wire, deadline_ms)
+            try:
+                return handle.reply(reply_timeout)
+            finally:
+                if not handle.done():
+                    # timed out / interrupted: never leave the id parked
+                    # in the reply map (it would pin a server-session
+                    # in-flight slot to a reply nobody consumes)
+                    self._discard(handle)
 
         return policy.run(
             attempt,
-            retry_on=lambda e: isinstance(e, ServeError)
-            and e.code == protocol.ERR_OVERLOADED,
+            # a deliberately-closed client must fail fast, not burn the
+            # retry budget reconnect-looping against itself
+            retry_on=lambda e: (isinstance(e, ConnectionError)
+                                and not self._closed)
+            or (isinstance(e, ServeError)
+                and e.code == protocol.ERR_OVERLOADED),
             site="client.submit")
 
     def status(self, timeout: float | None = 30.0) -> dict[str, Any]:
@@ -205,18 +299,22 @@ class CcsClient:
     # ---------------------------------------------------------- lifecycle
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        self._reader.join(timeout=5.0)
+        with self._conn_lock:
+            if self._closed:
+                return
+            self._closed = True
+            sock, reader = self._sock, self._reader
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if reader is not None:
+            reader.join(timeout=5.0)
 
     def __enter__(self) -> "CcsClient":
         return self

@@ -657,6 +657,10 @@ class CcsEngine:
         the process cannot clobber them."""
         with self._lock:
             snap = dict(
+                # False once close() began: the router's health probes
+                # read this to stop routing to a draining replica before
+                # its socket ever closes
+                accepting=not self._closed,
                 pending=self._pending,
                 admitted=self._admitted,
                 rejected=self._rejected,

@@ -9,6 +9,16 @@ transport-free -- encode/decode plus the ZMW/result wire layout -- so
 protocol tests never open a socket (server.py and client.py own the
 sockets).
 
+Request-id plumbing through the router tier (serve/router.py): `ccs
+router` rewrites the id on BOTH hops -- a client submit's id maps to a
+router-assigned `q<N>` toward the replica, and the replica's reply maps
+back before emission.  The router id is the failover/dedup key: after a
+replica failure the same `q<N>` may be resubmitted to another replica,
+and the first reply bearing it wins (later duplicates are dropped), so
+a client sees exactly one reply per id it sent.  Ids beginning `hc` on
+a replica link are the router's own status-verb health probes.  All of
+this is invisible at both edges; no wire shape changes.
+
 Client verbs:
   submit  {"verb": "submit", "id": ..., "zmw": <zmw>, "deadline_ms": ...}
   status  {"verb": "status", "id": ...}

@@ -1,0 +1,963 @@
+"""`ccs router`: a health-checked front door over N `ccs serve` replicas.
+
+The serve engine made one PROCESS the failure domain: a crashed or
+drained `ccs serve` loses every in-flight session.  The router lifts the
+device-fleet resilience idioms (pbccs_tpu/sched: sticky routing,
+bench-and-requeue, bounded failure tours) to replica granularity:
+
+  * **Sticky bucket-aware routing.**  Each submit is validated at the
+    edge (the same `chunk_from_wire` contract the replicas apply), keyed
+    by its approximate compiled-shape bucket (read-length geometry; the
+    replica's prep stage derives the exact bucket), and routed with the
+    shared ``sched.health.StickyMap`` -- the replica that already
+    compiled a bucket's program menu keeps receiving it, spilling to the
+    least-loaded healthy replica only past ``spill_depth`` in-flight
+    (work-conserving stickiness, exactly the DevicePool rule).
+  * **Health checks.**  A background loop probes every replica with the
+    protocol's `status` verb; a probe unanswered past
+    ``health_timeout_s`` is a strike, ``bench_after`` strikes mark the
+    replica unhealthy (``sched.health.HealthTracker``), and -- unlike a
+    benched device -- a later successful probe RE-ADMITS it (a restarted
+    replica routinely comes back).  `status` replies also carry the
+    replica's ``accepting`` flag, so a SIGTERM-draining replica stops
+    receiving new work before its socket ever closes.
+  * **Failover with exactly-once replies.**  Every client submit gets a
+    router-assigned request id (the protocol's id field is rewritten on
+    both hops).  When a replica dies (connection loss), times out its
+    probes, or rejects with `overloaded`/`closed`, its unanswered
+    requests are transparently resubmitted to a healthy replica the
+    request has not yet visited (``attempted`` bounds the tour to the
+    fleet, mirroring ``_Task.excluded``).  A reply that RACES a failover
+    is emitted exactly once: the first reply for an id wins, completes
+    the request, and any later duplicate finds the id retired and is
+    dropped (counted ``ccs_router_dedup_dropped_total``).  Polish is
+    pure, so the duplicated device work is waste, never corruption.
+
+The router front door reuses the serve server's framed-session armor
+(`server._FramedSession`): max frame length, idle reap, per-session
+in-flight cap, and abort accounting all behave identically at both
+tiers (tools/fuzz_inputs.py points the same wire legs at each).
+
+Metrics: ``ccs_router_routed_total{replica}``,
+``ccs_router_failovers_total{replica}``,
+``ccs_router_health_checks_total{replica,outcome}``,
+``ccs_router_replica_unhealthy_total{replica}``,
+``ccs_router_inflight{replica}``, ``ccs_router_dedup_dropped_total``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.runtime.logging import Logger, LogLevel
+from pbccs_tpu.sched.health import HealthPolicy, HealthTracker, StickyMap
+from pbccs_tpu.serve import protocol
+from pbccs_tpu.serve.server import CcsServer, _FramedSession
+
+_reg = default_registry()
+_m_dedup = _reg.counter(
+    "ccs_router_dedup_dropped_total",
+    "Late duplicate replies dropped after a reply/failover race "
+    "(exactly-once emission)")
+
+
+class RouterClosed(RuntimeError):
+    """Router is shutting down (or never started); no new requests."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router knobs (see module docstring for the policy they drive)."""
+
+    # ---- health probing ----
+    health_interval_s: float = 2.0   # probe cadence per replica
+    health_timeout_s: float = 5.0    # unanswered probe = one strike
+    bench_after: int = 2             # strikes before a replica is unhealthy
+    readmit_after: int = 1           # good probes before re-admission
+    connect_timeout_s: float = 5.0   # replica (re)connect bound
+    # ---- routing ----
+    # a home replica keeps its bucket while its in-flight depth is <=
+    # spill_depth; past it the least-loaded healthy replica takes the
+    # spill and becomes an additional home (work-conserving stickiness;
+    # ~one flush-worth of requests keeps a replica's pipeline fed)
+    spill_depth: int = 8
+    # ---- wire-protocol armor (enforced by the shared framed session;
+    # same semantics as the ServeConfig fields of the same name) ----
+    max_line_bytes: int = 8 << 20
+    max_inflight_per_session: int = 64
+    idle_timeout_s: float = 600.0
+
+    def __post_init__(self):
+        if self.bench_after < 1:
+            raise ValueError("bench_after must be >= 1")
+        if self.readmit_after < 1:
+            raise ValueError("readmit_after must be >= 1")
+        if self.spill_depth < 0:
+            raise ValueError("spill_depth must be >= 0")
+        # a zero interval busy-spins the health loop; a zero timeout
+        # strikes replicas that answer within milliseconds
+        if self.health_interval_s <= 0:
+            raise ValueError("health_interval_s must be > 0")
+        if self.health_timeout_s <= 0:
+            raise ValueError("health_timeout_s must be > 0")
+        if self.connect_timeout_s <= 0:
+            raise ValueError("connect_timeout_s must be > 0")
+
+
+def route_key(chunk) -> tuple[int, int]:
+    """Approximate compiled-shape bucket of a ZMW from read-length
+    geometry alone (the router never drafts): the median read length
+    stands in for the template length the replica's POA will produce.
+    Affinity only -- a mismatch costs a compile on the routed replica,
+    never correctness."""
+    from pbccs_tpu.parallel.batch import length_bucket
+
+    lens = sorted(len(r.seq) for r in chunk.reads)
+    return length_bucket(lens[len(lens) // 2], lens[-1])
+
+
+class RoutedRequest:
+    """One client submit in flight through the router; emitted exactly
+    once (guarded by the router lock via `done`)."""
+
+    __slots__ = ("rid", "key", "wire", "deadline_ms", "emit", "attempted",
+                 "assigned", "done", "submit_t")
+
+    def __init__(self, rid: str, key, wire: dict, deadline_ms,
+                 emit: Callable[[dict], None]):
+        self.rid = rid
+        self.key = key
+        self.wire = wire
+        self.deadline_ms = deadline_ms
+        self.emit = emit
+        self.attempted: set[str] = set()   # replica names tried
+        self.assigned: str | None = None
+        self.done = False
+        self.submit_t = time.monotonic()
+
+
+class ReplicaLink:
+    """One NDJSON/TCP connection from the router to a replica; replies
+    stream back through a dedicated reader thread."""
+
+    def __init__(self, router: "CcsRouter", replica: "_Replica",
+                 sock: socket.socket):
+        self._router = router
+        self._replica = replica
+        self._sock = sock
+        self._wlock = threading.Lock()
+        # alive transitions under their own lock: _wlock is held across
+        # a blocking sendall (frame atomicity on the replica hop), same
+        # discipline as server._FramedSession (ccs-analyze CONC001)
+        self._slock = threading.Lock()
+        self.alive = True
+        self.failed = False   # set once by the router's _fail_link sweep
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"ccs-router-link-{replica.name}")
+
+    def start(self) -> None:
+        self._reader.start()
+
+    def send(self, msg: dict) -> bool:
+        """Best-effort frame to the replica; False marks the link dead
+        (the caller runs the failover sweep, never this thread)."""
+        data = protocol.encode_msg(msg)
+        try:
+            with self._wlock:
+                self._sock.sendall(data)
+            return True
+        except OSError:
+            with self._slock:
+                self.alive = False
+            return False
+
+    def _read_loop(self) -> None:
+        try:
+            with self._sock.makefile("rb") as rf:
+                for line in rf:
+                    if not line.strip():
+                        continue
+                    try:
+                        msg = protocol.decode_line(line)
+                    except protocol.ProtocolError:
+                        continue  # never kill the link on one bad frame
+                    self._router._on_replica_msg(self._replica, self, msg)
+        except OSError:
+            pass  # connection loss; the finally block runs the failover
+        finally:
+            with self._slock:
+                self.alive = False
+            self._router._on_link_lost(self._replica, self)
+
+    def close(self) -> None:
+        with self._slock:
+            self.alive = False
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Replica:
+    """Router-side bookkeeping for one `ccs serve` backend (mutable
+    state guarded by the router lock)."""
+
+    def __init__(self, index: int, host: str, port: int):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.name = f"{host}:{port}"
+        self.link: ReplicaLink | None = None
+        self.connecting = False     # a reconnect attempt is in flight
+        self.draining = False       # replica said it stopped accepting
+        self.inflight: dict[str, RoutedRequest] = {}
+        self.probe_id: str | None = None
+        self.probe_t = 0.0
+        self.routed = 0
+        self.failovers = 0
+        self.m_routed = _reg.counter(
+            "ccs_router_routed_total",
+            "Requests routed to each replica", replica=self.name)
+        self.m_failover = _reg.counter(
+            "ccs_router_failovers_total",
+            "Unanswered requests resubmitted away from a replica "
+            "(connection loss, probe timeout, drain, backpressure)",
+            replica=self.name)
+        self.m_hc_ok = _reg.counter(
+            "ccs_router_health_checks_total",
+            "Router health probes by outcome",
+            replica=self.name, outcome="ok")
+        self.m_hc_fail = _reg.counter(
+            "ccs_router_health_checks_total",
+            replica=self.name, outcome="fail")
+        self.m_unhealthy = _reg.counter(
+            "ccs_router_replica_unhealthy_total",
+            "Times a replica was marked unhealthy", replica=self.name)
+        self.m_inflight = _reg.gauge(
+            "ccs_router_inflight",
+            "Requests in flight per replica", replica=self.name)
+
+    def depth(self) -> int:
+        return len(self.inflight)
+
+
+class CcsRouter:
+    """The replica-fleet scheduler behind the router front door (see
+    module docstring).  Engine-shaped for server.CcsServer: exposes
+    .config / .status() / .metrics_text(), and the router session calls
+    submit_routed()."""
+
+    def __init__(self, replicas, config: RouterConfig | None = None, *,
+                 logger: Logger | None = None):
+        """`replicas`: "host:port" strings or (host, port) pairs."""
+        self.config = config or RouterConfig()
+        self._log = logger or Logger.default()
+        parsed = []
+        for spec in replicas:
+            if isinstance(spec, str):
+                host, _, port_s = spec.rpartition(":")
+                try:
+                    parsed.append((host or "127.0.0.1", int(port_s)))
+                except ValueError:
+                    raise ValueError(
+                        f"replica spec {spec!r}: want HOST:PORT") from None
+            else:
+                host, port = spec
+                parsed.append((host, int(port)))
+        if not parsed:
+            raise ValueError("CcsRouter needs at least one replica")
+        self._replicas = [_Replica(i, h, p)
+                          for i, (h, p) in enumerate(parsed)]
+        self._by_name = {r.name: r for r in self._replicas}
+        self._lock = threading.Lock()
+        self._sticky = StickyMap()
+        self._health = HealthTracker(HealthPolicy(
+            bench_after=self.config.bench_after,
+            readmit_after=self.config.readmit_after))
+        self._requests: dict[str, RoutedRequest] = {}
+        self._seq = 0
+        self._probe_seq = 0
+        self._accepting = False    # submit gate (drain flips this first)
+        self._down = True          # hard stop (failover stops too)
+        self._routed_total = 0
+        self._completed_total = 0
+        self._failover_total = 0
+        self._dedup_total = 0
+        self._start_t = 0.0
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._emit_queue: queue.Queue | None = None
+        self._emit_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "CcsRouter":
+        with self._lock:
+            if self._accepting:
+                return self
+            self._accepting = True
+            self._down = False
+        self._start_t = time.monotonic()
+        for replica in self._replicas:
+            self._try_connect(replica)
+        self._stop.clear()
+        emit_queue: queue.Queue = queue.Queue()
+        emit_thread = threading.Thread(
+            target=self._emit_worker, args=(emit_queue,), daemon=True,
+            name="ccs-router-emit")
+        health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="ccs-router-health")
+        with self._lock:
+            self._emit_queue = emit_queue
+            self._emit_thread = emit_thread
+            self._health_thread = health_thread
+        emit_thread.start()
+        health_thread.start()
+        up = sum(1 for r in self._replicas if r.link is not None)
+        self._log.info(
+            f"ccs router up: {len(self._replicas)} replica(s) "
+            f"[{', '.join(r.name for r in self._replicas)}], "
+            f"{up} connected")
+        return self
+
+    def close(self, drain: bool = True,
+              deadline_s: float | None = None) -> bool:
+        """Stop admission; with drain (default) wait for in-flight
+        routed requests -- failover keeps working during the drain, so a
+        replica dying mid-drain does not strand its requests.  Past
+        ``deadline_s`` the remainder fail with a structured `closed`
+        error.  Returns True when everything completed normally."""
+        with self._lock:
+            if self._down and not self._accepting:
+                return True
+            self._accepting = False
+            pending0 = len(self._requests)
+        drained = drain or pending0 == 0
+        if drain:
+            give_up_at = (time.monotonic() + deadline_s
+                          if deadline_s else None)
+            while True:
+                with self._lock:
+                    if not self._requests:
+                        break
+                    pending = len(self._requests)
+                if give_up_at is not None and time.monotonic() > give_up_at:
+                    drained = False
+                    self._log.warn(
+                        f"router drain deadline ({deadline_s}s) exceeded "
+                        f"with {pending} request(s) pending: aborting")
+                    break
+                time.sleep(0.01)
+        self._stop.set()
+        with self._lock:
+            health_thread = self._health_thread
+            self._health_thread = None
+        if health_thread is not None:
+            health_thread.join(timeout=10.0)
+        with self._lock:
+            self._down = True
+            leftovers = [r for r in self._requests.values() if not r.done]
+            for req in leftovers:
+                req.done = True
+            self._requests.clear()
+            links = []
+            for replica in self._replicas:
+                replica.inflight.clear()
+                replica.m_inflight.set(0)
+                if replica.link is not None:
+                    links.append(replica.link)
+                    replica.link = None
+        for req in leftovers:
+            self._emit(req, protocol.error_to_wire(
+                None, protocol.ERR_CLOSED, "router is shutting down"))
+        for link in links:
+            link.close()
+        with self._lock:
+            emit_queue, self._emit_queue = self._emit_queue, None
+            emit_thread, self._emit_thread = self._emit_thread, None
+        if emit_queue is not None:
+            emit_queue.put(None)   # behind every queued reply
+        if emit_thread is not None:
+            emit_thread.join(timeout=10.0)
+        self._log.info("ccs router down")
+        return drained
+
+    def __enter__(self) -> "CcsRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ submission
+
+    def submit_routed(self, wire_zmw: dict, key, deadline_ms,
+                      emit: Callable[[dict], None]) -> RoutedRequest:
+        """Route one validated wire-shaped ZMW; `emit` receives exactly
+        one reply dict (result or structured error; the caller rewrites
+        the id).  Raises RouterClosed after close()."""
+        with self._lock:
+            if not self._accepting:
+                raise RouterClosed("router is not accepting requests")
+            self._seq += 1
+            rid = f"q{self._seq}"
+        req = RoutedRequest(rid, key, wire_zmw, deadline_ms, emit)
+        self._dispatch(req)
+        return req
+
+    def _routable_locked(self, replica: _Replica) -> bool:
+        return (replica.link is not None and replica.link.alive
+                and not replica.draining
+                and self._health.healthy(replica.name))
+
+    def _eligible_locked(self, req: RoutedRequest) -> list[_Replica]:
+        return [r for r in self._replicas
+                if r.name not in req.attempted and self._routable_locked(r)]
+
+    def _pick_locked(self, req: RoutedRequest) -> _Replica | None:
+        eligible = self._eligible_locked(req)
+        if not eligible:
+            return None
+
+        def load(r: _Replica):
+            return (r.depth(), self._sticky.resident_count(r.name), r.index)
+
+        target, _outcome = self._sticky.route(
+            req.key, eligible, member_id=lambda r: r.name, load=load,
+            depth=lambda r: r.depth(),
+            spill_depth=self.config.spill_depth)
+        return target
+
+    def _dispatch(self, req: RoutedRequest) -> None:
+        """Route + send, retrying across replicas until the frame is on
+        a wire or the fleet is exhausted.  Never called under the router
+        lock (sends block)."""
+        while True:
+            with self._lock:
+                if req.done:
+                    return
+                if self._down:
+                    fail = protocol.error_to_wire(
+                        None, protocol.ERR_CLOSED, "router is shutting down")
+                    self._complete_locked(req)
+                else:
+                    target = self._pick_locked(req)
+                    if target is None:
+                        code = (protocol.ERR_OVERLOADED
+                                if not req.attempted
+                                else protocol.ERR_INTERNAL)
+                        detail = ("no healthy replica available; retry"
+                                  if not req.attempted else
+                                  "request failed on every healthy replica "
+                                  f"(attempted: {sorted(req.attempted)})")
+                        fail = protocol.error_to_wire(None, code, detail)
+                        self._complete_locked(req)
+                    else:
+                        fail = None
+                        req.attempted.add(target.name)
+                        req.assigned = target.name
+                        target.inflight[req.rid] = req
+                        target.m_inflight.set(target.depth())
+                        self._requests[req.rid] = req
+                        self._sticky.note(req.key, target.name)
+                        target.routed += 1
+                        target.m_routed.inc()
+                        self._routed_total += 1
+                        link = target.link
+            if fail is not None:
+                self._emit(req, fail)
+                return
+            msg: dict[str, Any] = {"verb": protocol.VERB_SUBMIT,
+                                   "id": req.rid, "zmw": req.wire}
+            if req.deadline_ms is not None:
+                msg["deadline_ms"] = req.deadline_ms
+            if link.send(msg):
+                return
+            # the link died under us.  If the request is still parked on
+            # this replica, detach it (so the link's failure sweep does
+            # not double-dispatch it) and loop to try the next replica;
+            # if the sweep got here FIRST the request is already live
+            # elsewhere -- touching req.assigned now would orphan the
+            # new owner's inflight entry and double-dispatch the request
+            with self._lock:
+                if req.done:
+                    return
+                if target.inflight.get(req.rid) is req:
+                    del target.inflight[req.rid]
+                    target.m_inflight.set(target.depth())
+                    req.assigned = None
+                    target.failovers += 1
+                    target.m_failover.inc()
+                    self._failover_total += 1
+                    mine = True
+                else:
+                    mine = False
+            self._fail_link(target, link, "send failed")
+            if not mine:
+                return
+
+    def _emit(self, req: RoutedRequest, msg: dict) -> None:
+        """Hand a completed reply to the dedicated emission thread.
+        Emit callbacks write to CLIENT sockets (blocking, bounded only
+        by the session armor); run on a replica link's reader thread
+        they would starve that link's health-probe replies behind one
+        slow client and falsely bench a healthy replica -- the same
+        hand-off the serve engine does for batch completions."""
+        with self._lock:
+            q = self._emit_queue
+        if q is not None:
+            q.put((req, msg))
+            return
+        # router already torn down (or never started): emit inline,
+        # best-effort -- there is no reader thread left to protect
+        try:
+            req.emit(msg)
+        except Exception as e:  # noqa: BLE001 -- a dead client must not
+            # leak out of the teardown path
+            self._log.debug(f"router reply emit failed: {e!r}")
+
+    def _emit_worker(self, q: queue.Queue) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            req, msg = item
+            try:
+                req.emit(msg)
+            except Exception as e:  # noqa: BLE001 -- one dead client
+                # must never take the emission worker down
+                self._log.debug(f"router reply emit failed: {e!r}")
+
+    def _complete_locked(self, req: RoutedRequest) -> None:
+        """Retire a request (caller emits OUTSIDE the lock)."""
+        req.done = True
+        self._requests.pop(req.rid, None)
+        if req.assigned is not None:
+            owner = self._by_name[req.assigned]
+            if owner.inflight.pop(req.rid, None) is not None:
+                owner.m_inflight.set(owner.depth())
+        self._completed_total += 1
+
+    # ----------------------------------------------------------- replica IO
+
+    def _on_replica_msg(self, replica: _Replica, link: ReplicaLink,
+                        msg: dict) -> None:
+        mtype = msg.get("type")
+        if mtype == protocol.TYPE_CLOSED:
+            # unsolicited drain/idle notice: stop routing there, keep
+            # waiting on in-flight replies (they land before the replica
+            # closes the socket; a close without them is a link loss and
+            # the failover sweep picks them up)
+            with self._lock:
+                replica.draining = True
+            self._log.info(f"router: replica {replica.name} announced "
+                           f"close ({msg.get('reason')})")
+            return
+        rid = msg.get("id")
+        if isinstance(rid, str) and rid.startswith("hc"):
+            self._on_probe_reply(replica, msg)
+            return
+        resubmit = None
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.done:
+                # reply/failover race resolved in the other reply's
+                # favor (or a stale id): drop, exactly-once held
+                self._dedup_total += 1
+                _m_dedup.inc()
+                return
+            retryable = (mtype == protocol.TYPE_ERROR
+                         and msg.get("code") in (protocol.ERR_OVERLOADED,
+                                                 protocol.ERR_CLOSED))
+            if retryable and msg.get("code") == protocol.ERR_CLOSED:
+                replica.draining = True
+            owns = replica.inflight.get(rid) is req
+            if not owns and mtype == protocol.TYPE_ERROR:
+                # a STALE error from a replica this request already
+                # failed over from (probe-timeout sweep detached it):
+                # the current owner will answer; completing or
+                # re-routing on it would emit a spurious error for a
+                # request another replica is serving, or clobber that
+                # replica's ownership (the same still-parked rule
+                # _dispatch's send-failure path applies).  A stale
+                # RESULT, by contrast, is a valid answer and wins the
+                # race below.
+                self._dedup_total += 1
+                _m_dedup.inc()
+                return
+            if owns and retryable and self._eligible_locked(req):
+                # replica-side backpressure/drain: move the request to a
+                # replica that can absorb it instead of surfacing an
+                # error the rest of the fleet could have served
+                del replica.inflight[rid]
+                replica.m_inflight.set(replica.depth())
+                req.assigned = None
+                replica.failovers += 1
+                replica.m_failover.inc()
+                self._failover_total += 1
+                resubmit = req
+            else:
+                self._complete_locked(req)
+        if resubmit is not None:
+            self._dispatch(resubmit)
+        else:
+            self._emit(req, msg)
+
+    def _on_link_lost(self, replica: _Replica, link: ReplicaLink) -> None:
+        with self._lock:
+            if self._down:
+                return
+        self._fail_link(replica, link, "connection lost")
+
+    def _sweep_inflight_locked(self,
+                               replica: _Replica) -> list[RoutedRequest]:
+        """Detach every not-yet-done in-flight request from `replica`,
+        counting the failovers.  Caller holds the router lock and
+        re-dispatches the returned requests AFTER releasing it (the one
+        move-a-replica's-work transaction, shared by the link-failure
+        and probe-timeout-bench paths)."""
+        moved = [r for r in replica.inflight.values() if not r.done]
+        replica.inflight.clear()
+        replica.m_inflight.set(0)
+        for req in moved:
+            req.assigned = None
+        if moved:
+            replica.failovers += len(moved)
+            replica.m_failover.inc(len(moved))
+            # caller holds self._lock (the _locked-suffix contract)
+            # ccs-analyze: ignore[CONC001]
+            self._failover_total += len(moved)
+        return moved
+
+    def _fail_link(self, replica: _Replica, link: ReplicaLink,
+                   why: str) -> None:
+        """One dead link: detach it, strike the replica's health, and
+        re-dispatch its unanswered requests elsewhere.  Idempotent per
+        link object (send failures and the reader's EOF both land
+        here)."""
+        with self._lock:
+            if link.failed:
+                return
+            link.failed = True
+            if replica.link is link:
+                replica.link = None
+            moved = self._sweep_inflight_locked(replica)
+            replica.probe_id = None
+            benched = self._health.record_failure(replica.name)
+            if benched:
+                replica.m_unhealthy.inc()
+                self._sticky.forget_member(replica.name)
+        self._log.warn(
+            f"router: replica {replica.name} link down ({why}); "
+            f"failing over {len(moved)} in-flight request(s)")
+        link.close()
+        for req in moved:
+            self._dispatch(req)
+
+    # --------------------------------------------------------------- health
+
+    def _try_connect(self, replica: _Replica) -> None:
+        try:
+            sock = socket.create_connection(
+                (replica.host, replica.port),
+                timeout=self.config.connect_timeout_s)
+        except OSError:
+            return  # stays down; routing skips it, next tick retries
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        link = ReplicaLink(self, replica, sock)
+        with self._lock:
+            if self._down or replica.link is not None:
+                stale = True
+            else:
+                stale = False
+                replica.link = link
+                # a fresh connection says nothing about engine health; a
+                # reconnect after drain must also clear the drain flag so
+                # the next probe can re-admit a restarted replica
+                replica.draining = False
+                replica.probe_id = None
+        if stale:
+            link.close()
+            return
+        link.start()
+        self._log.info(f"router: connected to replica {replica.name}")
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.config.health_interval_s):
+            for replica in self._replicas:
+                self._probe(replica)
+
+    def _probe(self, replica: _Replica) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._down:
+                return
+            link = replica.link
+            outstanding = replica.probe_id
+            sent_t = replica.probe_t
+        if link is None or not link.alive:
+            # reconnect OFF the health thread: a blocking connect() to a
+            # down replica (up to connect_timeout_s) would stretch the
+            # probe cadence for every HEALTHY replica behind it
+            with self._lock:
+                if replica.connecting or self._down:
+                    return
+                replica.connecting = True
+
+            def attempt(replica=replica):
+                try:
+                    self._try_connect(replica)
+                finally:
+                    with self._lock:
+                        replica.connecting = False
+
+            threading.Thread(
+                target=attempt, daemon=True,
+                name=f"ccs-router-connect-{replica.name}").start()
+            return
+        if outstanding is not None:
+            if now - sent_t < self.config.health_timeout_s:
+                return  # still within the reply window
+            # unanswered probe: one strike; benching moves the in-flight
+            # requests but KEEPS the link open, so a late reply still
+            # wins the exactly-once race instead of being torn down
+            moved: list[RoutedRequest] = []
+            with self._lock:
+                replica.probe_id = None
+                benched = self._health.record_failure(replica.name)
+                if benched:
+                    replica.m_unhealthy.inc()
+                    self._sticky.forget_member(replica.name)
+                    moved = self._sweep_inflight_locked(replica)
+            replica.m_hc_fail.inc()
+            if benched:
+                self._log.warn(
+                    f"router: replica {replica.name} unhealthy (probe "
+                    f"timeout); failing over {len(moved)} request(s)")
+            for req in moved:
+                self._dispatch(req)
+            return
+        self._probe_seq += 1
+        pid = f"hc{self._probe_seq}"
+        with self._lock:
+            replica.probe_id = pid
+            replica.probe_t = now
+        if not link.send({"verb": protocol.VERB_STATUS, "id": pid}):
+            self._fail_link(replica, link, "health probe send failed")
+
+    def _on_probe_reply(self, replica: _Replica, msg: dict) -> None:
+        accepting = bool(msg.get("accepting", True))
+        with self._lock:
+            if msg.get("id") != replica.probe_id:
+                # a STALE probe reply (its timeout already struck, or it
+                # belongs to a previous link): crediting it would reset
+                # the strike count of a replica that persistently
+                # answers slower than health_timeout_s, and count toward
+                # re-admission of a benched one -- only the outstanding
+                # probe's reply is evidence of current health
+                return
+            replica.probe_id = None
+            replica.draining = not accepting
+            recovered = self._health.record_success(replica.name)
+        replica.m_hc_ok.inc()
+        if recovered:
+            self._log.info(f"router: replica {replica.name} recovered; "
+                           "re-admitted to routing")
+
+    # ------------------------------------------- status / metrics (session)
+
+    def status(self) -> dict:
+        with self._lock:
+            replicas = [{
+                "replica": r.name,
+                "connected": r.link is not None and r.link.alive,
+                "healthy": self._health.healthy(r.name),
+                "draining": r.draining,
+                "inflight": r.depth(),
+                "routed": r.routed,
+                "failovers": r.failovers,
+            } for r in self._replicas]
+            return {
+                "engine": "ccs-router",
+                "accepting": self._accepting,
+                "uptime_s": round(time.monotonic() - self._start_t, 3),
+                "pending": len(self._requests),
+                "routed": self._routed_total,
+                "completed": self._completed_total,
+                "failovers": self._failover_total,
+                "deduped": self._dedup_total,
+                "replicas": replicas,
+            }
+
+    def metrics_text(self) -> str:
+        return _reg.render_prometheus()
+
+
+class _RouterSession(_FramedSession):
+    """A framed session bound to the replica router: submits are
+    validated at the edge, then fanned out; replica replies pass through
+    verbatim with the id rewritten back to the client's."""
+
+    def _on_submit(self, msg: dict) -> None:
+        rid = msg.get("id")
+        if not self._try_acquire_slot(rid):
+            return
+        parsed = self._parse_submit(msg)
+        if parsed is None:
+            self._release_slot()
+            return
+        chunk, deadline_ms = parsed
+
+        def on_reply(reply: dict) -> None:
+            self._release_slot()
+            out = dict(reply)
+            out["id"] = rid
+            self.send(out)
+
+        try:
+            # forward the NORMALIZED wire form (defaults filled, floats
+            # coerced): both hops then carry the exact payload the
+            # validation accepted
+            self.server.engine.submit_routed(
+                protocol.chunk_to_wire(chunk), route_key(chunk),
+                deadline_ms, on_reply)
+        except RouterClosed as e:
+            self._release_slot()
+            self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
+                                             str(e)))
+
+
+class RouterServer(CcsServer):
+    """The router's TCP front: the serve accept loop + session armor
+    over a CcsRouter instead of a local engine."""
+
+    session_class = _RouterSession
+    name = "ccs router"
+
+
+# ------------------------------------------------------------------ ccs router
+
+def build_router_parser() -> argparse.ArgumentParser:
+    defaults = RouterConfig()
+    p = argparse.ArgumentParser(
+        prog="ccs router",
+        description="Health-checked front door spreading CCS serve "
+                    "sessions across N `ccs serve` replicas with sticky "
+                    "bucket routing and zero-loss failover.")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="Bind address. Default = %(default)s")
+    p.add_argument("--port", type=int, default=7330,
+                   help="Bind port (0 = ephemeral). Default = %(default)s")
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="One `ccs serve` backend (repeatable).")
+    p.add_argument("--routerHealthInterval", type=float,
+                   default=defaults.health_interval_s,
+                   help="Seconds between status-verb health probes per "
+                        "replica. Default = %(default)s")
+    p.add_argument("--routerHealthTimeout", type=float,
+                   default=defaults.health_timeout_s,
+                   help="Probe unanswered this long = one strike. "
+                        "Default = %(default)s")
+    p.add_argument("--routerBenchAfter", type=int,
+                   default=defaults.bench_after,
+                   help="Consecutive strikes before a replica is marked "
+                        "unhealthy (in-flight requests fail over). "
+                        "Default = %(default)s")
+    p.add_argument("--routerReadmitAfter", type=int,
+                   default=defaults.readmit_after,
+                   help="Consecutive good probes before an unhealthy "
+                        "replica is re-admitted. Default = %(default)s")
+    p.add_argument("--routerSpillDepth", type=int,
+                   default=defaults.spill_depth,
+                   help="In-flight depth past which a sticky bucket "
+                        "spills off its home replica. "
+                        "Default = %(default)s")
+    # the same wire armor the replicas enforce, applied at the edge
+    p.add_argument("--maxLineBytes", type=int,
+                   default=defaults.max_line_bytes,
+                   help="Longest accepted NDJSON frame. "
+                        "Default = %(default)s")
+    p.add_argument("--maxInflightPerSession", type=int,
+                   default=defaults.max_inflight_per_session,
+                   help="Per-session in-flight submit cap. "
+                        "Default = %(default)s")
+    p.add_argument("--idleTimeout", type=float,
+                   default=defaults.idle_timeout_s,
+                   help="Reap idle sessions after this many seconds; "
+                        "0 disables. Default = %(default)s")
+    p.add_argument("--drainTimeout", type=float, default=30.0,
+                   help="On SIGTERM/SIGINT, wait this long for routed "
+                        "in-flight requests before failing the rest. "
+                        "Default = %(default)s")
+    p.add_argument("--logLevel", default="INFO")
+    return p
+
+
+def run_router(argv: list[str] | None = None) -> int:
+    """`ccs router` entry point (dispatched from pbccs_tpu.cli)."""
+    args = build_router_parser().parse_args(argv)
+    log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+    try:
+        config = RouterConfig(
+            health_interval_s=args.routerHealthInterval,
+            health_timeout_s=args.routerHealthTimeout,
+            bench_after=args.routerBenchAfter,
+            readmit_after=args.routerReadmitAfter,
+            spill_depth=args.routerSpillDepth,
+            max_line_bytes=args.maxLineBytes,
+            max_inflight_per_session=args.maxInflightPerSession,
+            idle_timeout_s=args.idleTimeout)
+        router = CcsRouter(args.replica, config, logger=log)
+    except ValueError as e:
+        # a knob or replica spec the dataclass/router rejected: a clean
+        # usage error, not a traceback (the message names the field)
+        print(f"ccs router: {e}", file=sys.stderr)
+        return 2
+    with router:
+        server = RouterServer(router, args.host, args.port, logger=log)
+        server.start()
+        # machine-readable ready line for wrappers (mirrors CCS-SERVE-READY)
+        print(f"CCS-ROUTER-READY {server.host} {server.port}", flush=True)
+
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            print(f"CCS-ROUTER-DRAINING "
+                  f"signal={signal.Signals(signum).name}", flush=True)
+            stop.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _on_signal)
+            except ValueError:  # not the main thread (embedded router)
+                pass
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        log.info("ccs router draining: admission stopped, waiting for "
+                 f"routed requests (deadline {args.drainTimeout}s)")
+        server.stop_accepting()
+        server.notify_draining()
+        drained = router.close(drain=True, deadline_s=args.drainTimeout)
+        server.shutdown()
+        log.info("ccs router drained cleanly" if drained
+                 else "ccs router drain deadline hit; failed remainder")
+    log.flush()
+    return 0

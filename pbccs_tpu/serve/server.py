@@ -54,8 +54,19 @@ def _count_abort(cause: str) -> None:
                  cause=cause).inc()
 
 
-class _Session:
-    """One connected client: a reader loop + a locked writer."""
+class _FramedSession:
+    """One connected client: a reader loop + a locked writer.
+
+    Owns everything front-door-generic -- the bounded NDJSON framing
+    loop, the wire-protocol armor (max frame length, idle reap,
+    per-session in-flight cap), abort accounting, and the status /
+    metrics / ping verbs -- against any `engine`-shaped front
+    (`server.engine` must expose .config with the armor fields,
+    .status(), and .metrics_text()).  `_Session` binds it to a local
+    CcsEngine; the replica router's session (serve/router.py) binds the
+    SAME armor to its fan-out front door, so the hostile-input
+    guarantees hold identically at both tiers (tools/fuzz_inputs.py
+    points the same wire legs at each)."""
 
     _RECV = 1 << 16
 
@@ -98,10 +109,13 @@ class _Session:
                     "marking session dead")
                 _count_abort("send_failed")
 
-    # ------------------------------------------------------------- verbs
+    # ------------------------------------------------------------- armor
 
-    def _on_submit(self, msg: dict) -> None:
-        rid = msg.get("id")
+    def _try_acquire_slot(self, rid) -> bool:
+        """Reserve one in-flight slot for a submit; a capped session gets
+        a structured `overloaded` reply BEFORE parsing/admission (one
+        hostile session can neither monopolize the engine pool nor make
+        it parse unbounded payloads it will reject anyway)."""
         cap = self.server.engine.config.max_inflight_per_session
         with self._ilock:
             if self._inflight >= cap:
@@ -110,56 +124,46 @@ class _Session:
                 capped = False
                 self._inflight += 1
         if capped:
-            # rejected BEFORE parsing/admission: one hostile session can
-            # neither monopolize the engine pool nor make it parse
-            # unbounded payloads it will reject anyway
             _m_cap_rejects.inc()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_OVERLOADED,
                 f"per-session in-flight cap ({cap}) reached; "
                 "wait for results before submitting more"))
-            return
+            return False
+        return True
 
-        def release() -> None:
-            with self._ilock:
-                self._inflight -= 1
+    def _release_slot(self) -> None:
+        with self._ilock:
+            self._inflight -= 1
 
+    # ------------------------------------------------------------- verbs
+
+    def _on_submit(self, msg: dict) -> None:
+        raise NotImplementedError   # front-door specific (_Session/router)
+
+    def _on_trace(self, msg: dict) -> None:
+        self.send(protocol.error_to_wire(
+            msg.get("id"), protocol.ERR_BAD_REQUEST,
+            "trace is not supported by this front door"))
+
+    def _parse_submit(self, msg: dict):
+        """Shared submit decode: validated chunk + deadline, or None after
+        a structured `bad_request` reply (the caller already released its
+        slot-acquire responsibilities via the returned sentinel)."""
+        rid = msg.get("id")
         try:
             chunk = protocol.chunk_from_wire(msg.get("zmw"))
         except protocol.ProtocolError as e:
-            release()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, str(e)))
-            return
+            return None
         deadline_ms = msg.get("deadline_ms")
         if deadline_ms is not None and not isinstance(deadline_ms,
                                                       (int, float)):
-            release()
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, "deadline_ms must be a number"))
-            return
-
-        def on_done(req: Request) -> None:
-            release()
-            if req.error is not None:
-                self.send(protocol.error_to_wire(
-                    rid, protocol.ERR_INTERNAL, req.error))
-            else:
-                self.send(protocol.result_to_wire(
-                    rid, req.chunk.id, req.failure, req.result,
-                    req.latency_ms))
-
-        try:
-            self.server.engine.submit(chunk, deadline_ms=deadline_ms,
-                                      callback=on_done)
-        except EngineOverloaded as e:
-            release()
-            self.send(protocol.error_to_wire(
-                rid, protocol.ERR_OVERLOADED, str(e)))
-        except EngineClosed as e:
-            release()
-            self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
-                                             str(e)))
+            return None
+        return chunk, deadline_ms
 
     def _on_status(self, msg: dict) -> None:
         status = self.server.engine.status()
@@ -172,27 +176,6 @@ class _Session:
         self.send({"type": protocol.TYPE_METRICS, "id": msg.get("id"),
                    "content_type": protocol.METRICS_CONTENT_TYPE,
                    "body": self.server.engine.metrics_text()})
-
-    def _on_trace(self, msg: dict) -> None:
-        rid = msg.get("id")
-        action = msg.get("action")
-        if action == "start":
-            started = self.server.engine.trace_start()
-            self.send({"type": protocol.TYPE_TRACE, "id": rid,
-                       "state": "started" if started
-                       else "already_running"})
-        elif action == "stop":
-            chrome = self.server.engine.trace_stop()
-            reply = {"type": protocol.TYPE_TRACE, "id": rid,
-                     "state": "stopped" if chrome is not None
-                     else "not_running"}
-            if chrome is not None:
-                reply["trace"] = chrome
-            self.send(reply)
-        else:
-            self.send(protocol.error_to_wire(
-                rid, protocol.ERR_BAD_REQUEST,
-                'trace.action must be "start" or "stop"'))
 
     # ------------------------------------------------------------- reader
 
@@ -280,8 +263,74 @@ class _Session:
             log.debug(f"session closed: {self.peer}")
 
 
+class _Session(_FramedSession):
+    """A framed session bound to a LOCAL CcsEngine (the `ccs serve`
+    front door): submits admit into the engine, trace drives the
+    engine's span capture."""
+
+    def _on_submit(self, msg: dict) -> None:
+        rid = msg.get("id")
+        if not self._try_acquire_slot(rid):
+            return
+        parsed = self._parse_submit(msg)
+        if parsed is None:
+            self._release_slot()
+            return
+        chunk, deadline_ms = parsed
+
+        def on_done(req: Request) -> None:
+            self._release_slot()
+            if req.error is not None:
+                self.send(protocol.error_to_wire(
+                    rid, protocol.ERR_INTERNAL, req.error))
+            else:
+                self.send(protocol.result_to_wire(
+                    rid, req.chunk.id, req.failure, req.result,
+                    req.latency_ms))
+
+        try:
+            self.server.engine.submit(chunk, deadline_ms=deadline_ms,
+                                      callback=on_done)
+        except EngineOverloaded as e:
+            self._release_slot()
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_OVERLOADED, str(e)))
+        except EngineClosed as e:
+            self._release_slot()
+            self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
+                                             str(e)))
+
+    def _on_trace(self, msg: dict) -> None:
+        rid = msg.get("id")
+        action = msg.get("action")
+        if action == "start":
+            started = self.server.engine.trace_start()
+            self.send({"type": protocol.TYPE_TRACE, "id": rid,
+                       "state": "started" if started
+                       else "already_running"})
+        elif action == "stop":
+            chrome = self.server.engine.trace_stop()
+            reply = {"type": protocol.TYPE_TRACE, "id": rid,
+                     "state": "stopped" if chrome is not None
+                     else "not_running"}
+            if chrome is not None:
+                reply["trace"] = chrome
+            self.send(reply)
+        else:
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_BAD_REQUEST,
+                'trace.action must be "start" or "stop"'))
+
+
 class CcsServer:
-    """Threaded NDJSON-over-TCP server fronting one CcsEngine."""
+    """Threaded NDJSON-over-TCP server fronting one CcsEngine.
+
+    Subclasses swap `session_class`/`name` to front a different
+    engine-shaped object with the same accept loop + armor (the replica
+    router's RouterServer does)."""
+
+    session_class: type = _Session
+    name = "ccs serve"
 
     def __init__(self, engine: CcsEngine, host: str = "127.0.0.1",
                  port: int = 0, logger: Logger | None = None):
@@ -322,7 +371,7 @@ class CcsServer:
             # (power loss, NAT timeout): without it the reader thread and
             # fd of every half-open session leak for the server's lifetime
             conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-            session = _Session(self, conn, peer)
+            session = self.session_class(self, conn, peer)
             with self._slock:
                 self._sessions.add(session)
             threading.Thread(target=session.run, daemon=True,
@@ -333,7 +382,7 @@ class CcsServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="ccs-serve-accept")
         self._accept_thread.start()
-        self.log.info(f"ccs serve listening on {self.host}:{self.port}")
+        self.log.info(f"{self.name} listening on {self.host}:{self.port}")
         return self
 
     def serve_forever(self) -> None:
@@ -459,6 +508,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="On SIGTERM/SIGINT, wait this long for in-flight "
                         "requests before fast-aborting the rest. "
                         "Default = %(default)s")
+    p.add_argument("--compileCache", default=None, metavar="DIR",
+                   help="Persistent XLA compilation-cache directory "
+                        "shared across replicas/restarts: a rolling "
+                        "restart reloads its compiled polish programs "
+                        "from disk in seconds instead of recompiling "
+                        "(default: JAX_COMPILATION_CACHE_DIR, else the "
+                        "checkout-local .jax_cache).")
     # consensus + resilience knobs shared (definition and defaults) with
     # the offline CLI; serve maps --polishTimeout to the ENGINE-level
     # watchdog (ServeConfig.polish_timeout_ms) rather than the ambient
@@ -486,7 +542,7 @@ def run_serve(argv: list[str] | None = None) -> int:
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 
-    enable_compilation_cache()
+    enable_compilation_cache(args.compileCache)
     log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
 
     from pbccs_tpu.cli import consensus_settings_from_args

@@ -84,7 +84,7 @@ def test_sticky_spills_when_home_busy():
         d1 = f1.result(30)
         assert d1.id != d2.id
         # the spill target became an additional home
-        assert len(pool._homes["k"]) == 2
+        assert len(pool._sticky.homes("k")) == 2
 
 
 def test_roundrobin_policy_cycles():

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Fleet smoke for the tier-1 gate: 3 `ccs serve` replicas behind `ccs
+router`, with chaos at PROCESS granularity.
+
+The serve/sched smokes prove resilience when a DEVICE dies inside one
+process; this gate proves the replica tier: a whole `ccs serve` process
+vanishing (kill -9) or leaving politely (SIGTERM drain) mid-stream must
+cost ZERO requests -- every submit is answered exactly once, and every
+consensus is byte-identical to the offline driver.
+
+Legs:
+
+  baseline  offline process_chunks over the workload (the byte-identity
+            reference), computed in-process
+  kill9     24 requests streamed through the router; one replica with
+            requests in flight is kill -9'd: every request answers
+            EXACTLY once (raw-socket reply counting, not a client that
+            would mask duplicates), all Success, sequences + QVs
+            byte-identical to offline, ccs_router_failovers_total moved
+  drain     a second round; one replica gets SIGTERM under load: the
+            replica announces CCS-SERVE-DRAINING, exits 0, and again
+            zero lost / zero duplicated / byte-identical
+
+The workload reuses the chaos-cell geometry (tpl 60, 5 passes, seed
+20260803) so its compiled shapes are already in the persistent cache
+from the chaos/fuzz smokes.  Replica subprocesses inherit this process's
+environment (same polish path as the offline baseline).
+
+Run:  JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # runnable as tools/fleet_smoke.py from the repo root
+
+N_ZMWS = 12
+REPLICAS = 3
+REPLY_TIMEOUT_S = 600.0
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}"
+          + (f"  ({detail})" if detail else ""), flush=True)
+    if not ok:
+        raise SystemExit(f"fleet smoke failed: {name} {detail}")
+
+
+def make_workload():
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.pipeline import Chunk, Subread
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(20260803)
+    chunks, wires = [], []
+    for i in range(N_ZMWS):
+        _, reads, _, snr = simulate_zmw(rng, 60, 5)
+        zid = f"fleet/{i}"
+        chunks.append(Chunk(
+            zid, [Subread(f"{zid}/{k}", r) for k, r in enumerate(reads)],
+            snr))
+        wires.append({"id": zid, "snr": [float(s) for s in snr],
+                      "reads": [{"seq": decode_bases(r)} for r in reads]})
+    return chunks, wires
+
+
+def spawn_ready(subcmd_args: list[str],
+                marker: str) -> tuple[subprocess.Popen, int]:
+    """One `ccs <subcmd>` subprocess; block until its machine-readable
+    ready line (`CCS-*-READY HOST PORT`) and return (proc, port)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbccs_tpu.cli"] + subcmd_args,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    while line and not line.startswith(marker):
+        line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise SystemExit(f"{marker} never seen (rc={proc.poll()})")
+    return proc, int(line.split()[2])
+
+
+def spawn_replica() -> tuple[subprocess.Popen, int]:
+    return spawn_ready(
+        ["serve", "--port", "0", "--maxBatch", "4", "--maxWaitMs", "250",
+         # the router multiplexes every client over ONE replica session:
+         # size the per-session cap to the admission bound so the armor
+         # (built for hostile clients) never throttles the trusted link
+         "--maxInflightPerSession", "256",
+         "--drainTimeout", "300", "--logLevel", "ERROR"],
+        "CCS-SERVE-READY")
+
+
+def spawn_router(ports: list[int]) -> tuple[subprocess.Popen, int]:
+    argv = ["router", "--port", "0", "--logLevel", "ERROR",
+            "--routerHealthInterval", "0.5", "--routerHealthTimeout", "3"]
+    for p in ports:
+        argv += ["--replica", f"127.0.0.1:{p}"]
+    return spawn_ready(argv, "CCS-ROUTER-READY")
+
+
+def router_status(port: int) -> dict:
+    with socket.create_connection(("127.0.0.1", port), timeout=30.0) as c:
+        c.sendall(b'{"verb":"status","id":"st"}\n')
+        rf = c.makefile("rb")
+        while True:
+            msg = json.loads(rf.readline())
+            if msg.get("id") == "st":
+                return msg
+
+
+def router_metrics(port: int) -> dict[str, float]:
+    with socket.create_connection(("127.0.0.1", port), timeout=30.0) as c:
+        c.sendall(b'{"verb":"metrics","id":"m"}\n')
+        rf = c.makefile("rb")
+        while True:
+            msg = json.loads(rf.readline())
+            if msg.get("id") == "m":
+                break
+    out: dict[str, float] = {}
+    for line in msg.get("body", "").splitlines():
+        if line and not line.startswith("#"):
+            name, _, value = line.rpartition(" ")
+            try:
+                out[name] = float(value)
+            except ValueError:
+                continue
+    return out
+
+
+def run_leg(name: str, router_port: int, wires, prefix: str,
+            chaos) -> dict[str, dict]:
+    """Submit every ZMW on one raw session, run `chaos(submitted)` once
+    requests are demonstrably in flight, then count EVERY reply frame:
+    exactly one per request id (a dedup failure shows up as a second
+    frame, which a re-associating client would silently mask)."""
+    conn = socket.create_connection(("127.0.0.1", router_port),
+                                    timeout=REPLY_TIMEOUT_S)
+    rf = conn.makefile("rb")
+    ids = [f"{prefix}{i}" for i in range(len(wires))]
+    for rid, z in zip(ids, wires):
+        conn.sendall(json.dumps(
+            {"verb": "submit", "id": rid, "zmw": z}).encode() + b"\n")
+    chaos()
+    counts = {rid: 0 for rid in ids}
+    results: dict[str, dict] = {}
+    try:
+        while len(results) < len(ids):
+            line = rf.readline()
+            if not line:
+                break
+            msg = json.loads(line)
+            rid = msg.get("id")
+            if rid in counts:
+                counts[rid] += 1
+                results[rid] = msg
+    except (socket.timeout, TimeoutError):
+        pass  # lost requests surface in the zero-lost check below
+    # linger to catch any late duplicate frame the router failed to dedup
+    conn.settimeout(2.0)
+    extras = 0
+    try:
+        while True:
+            line = rf.readline()
+            if not line:
+                break
+            if json.loads(line).get("id") in counts:
+                extras += 1
+    except (socket.timeout, TimeoutError):
+        pass
+    conn.close()
+    check(f"{name}: zero lost requests", len(results) == len(ids),
+          f"{len(results)}/{len(ids)} answered")
+    check(f"{name}: zero duplicated requests",
+          extras == 0 and all(c == 1 for c in counts.values()),
+          f"extras={extras} counts={sorted(set(counts.values()))}")
+    check(f"{name}: all Success",
+          all(m.get("status") == "Success" for m in results.values()),
+          str({m.get("status") or m.get("code")
+               for m in results.values()}))
+    return results
+
+
+def wait_for_victim(router_port: int, deadline_s: float = 120.0) -> str:
+    """Block until some replica has requests in flight; return its name
+    (the chaos target must demonstrably be mid-stream)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        st = router_status(router_port)
+        busy = [r for r in st["replicas"] if r["inflight"] > 0]
+        if busy:
+            return max(busy, key=lambda r: r["inflight"])["replica"]
+        time.sleep(0.05)
+    raise SystemExit("no replica ever had requests in flight")
+
+
+def main() -> int:
+    from pbccs_tpu.pipeline import ConsensusSettings, process_chunks
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+    from pbccs_tpu.runtime.logging import Logger, LogLevel
+
+    enable_compilation_cache()
+    Logger.default(Logger(level=LogLevel.ERROR))
+    chunks, wires = make_workload()
+
+    print("== baseline (offline process_chunks) ==", flush=True)
+    t0 = time.monotonic()
+    offline = process_chunks(list(chunks), ConsensusSettings())
+    offline_out = {r.id: (r.sequence, r.qualities)
+                   for r in offline.results}
+    check("baseline yields all successes",
+          len(offline_out) == N_ZMWS,
+          f"{len(offline_out)}/{N_ZMWS} in {time.monotonic() - t0:.0f}s")
+
+    replicas = [spawn_replica() for _ in range(REPLICAS)]
+    ports = [port for _, port in replicas]
+    router_proc, router_port = spawn_router(ports)
+    try:
+        print("== leg: replica kill -9 mid-stream ==", flush=True)
+        m0 = router_metrics(router_port)
+
+        def kill9():
+            victim = wait_for_victim(router_port)
+            vport = int(victim.rsplit(":", 1)[1])
+            proc = replicas[ports.index(vport)][0]
+            proc.kill()
+            print(f"  kill -9 replica {victim}", flush=True)
+
+        results = run_leg("kill9", router_port, wires, "k", kill9)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("kill9: byte-identical to offline", got == offline_out)
+        m1 = router_metrics(router_port)
+
+        def delta(name_prefix: str) -> float:
+            return (sum(v for k, v in m1.items()
+                        if k.startswith(name_prefix))
+                    - sum(v for k, v in m0.items()
+                          if k.startswith(name_prefix)))
+
+        check("kill9: failovers counted",
+              delta("ccs_router_failovers_total") >= 1,
+              f"{delta('ccs_router_failovers_total'):.0f} failover(s)")
+        st = router_status(router_port)
+        check("kill9: dead replica disconnected",
+              sum(1 for r in st["replicas"] if not r["connected"]) >= 1)
+
+        print("== leg: SIGTERM drain under load ==", flush=True)
+
+        def drain():
+            victim = wait_for_victim(router_port)
+            vport = int(victim.rsplit(":", 1)[1])
+            proc = replicas[ports.index(vport)][0]
+            proc.send_signal(signal.SIGTERM)
+            print(f"  SIGTERM replica {victim}", flush=True)
+            drained_proc.append(proc)
+
+        drained_proc: list[subprocess.Popen] = []
+        results = run_leg("drain", router_port, wires, "d", drain)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("drain: byte-identical to offline", got == offline_out)
+        if drained_proc:
+            rc = drained_proc[0].wait(timeout=300)
+            check("drain: replica exited 0", rc == 0, f"exit {rc}")
+        check("drain: health checks ran",
+              sum(v for k, v in router_metrics(router_port).items()
+                  if k.startswith("ccs_router_health_checks_total")) > 0)
+
+        print("== router drains cleanly ==", flush=True)
+        router_proc.send_signal(signal.SIGTERM)
+        rc = router_proc.wait(timeout=60)
+        check("router exited 0 on SIGTERM", rc == 0, f"exit {rc}")
+    finally:
+        for proc, _ in replicas:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+        if router_proc.poll() is None:
+            router_proc.kill()
+            router_proc.wait(10)
+
+    print("fleet smoke: all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -396,6 +396,40 @@ def _stub_server(max_line=4096, idle_s=0.0, cap=64, gate=None):
     return eng, srv
 
 
+def _stub_front(kind, max_line=4096, idle_s=0.0, cap=64, gate=None):
+    """The wire-armor target: either a bare stub `ccs serve` stack, or
+    the SAME stack fronted by a one-replica `ccs router` whose session
+    armor carries the tight limits (the backend keeps generous ones, so
+    every rejection under test is the ROUTER's).  Returns (server-like
+    with .host/.port, teardown callable)."""
+    if kind == "serve":
+        eng, srv = _stub_server(max_line=max_line, idle_s=idle_s, cap=cap,
+                                gate=gate)
+
+        def teardown():
+            srv.shutdown()
+            eng.close()
+
+        return srv, teardown
+    from pbccs_tpu.serve.router import CcsRouter, RouterConfig, RouterServer
+
+    eng, srv = _stub_server(gate=gate)  # backend: default (loose) armor
+    router = CcsRouter(
+        [f"127.0.0.1:{srv.port}"],
+        RouterConfig(health_interval_s=0.2, max_line_bytes=max_line,
+                     idle_timeout_s=idle_s,
+                     max_inflight_per_session=cap)).start()
+    rsrv = RouterServer(router, port=0).start()
+
+    def teardown():
+        rsrv.shutdown()
+        router.close(drain=False)
+        srv.shutdown()
+        eng.close()
+
+    return rsrv, teardown
+
+
 def _session(srv, timeout=10.0):
     conn = socket.create_connection((srv.host, srv.port), timeout=timeout)
     return conn, conn.makefile("rb")
@@ -406,21 +440,27 @@ def _reply(rf):
     return json.loads(line) if line else None
 
 
-def leg_wire(report: dict) -> None:
-    print("== leg: wire-protocol armor ==")
+def leg_wire(report: dict, kind: str = "serve") -> None:
+    """The wire-armor invariants, against either front door: the bare
+    serve session (`kind="serve"`, tags `wire:*`) or the router session
+    in front of a loose-armored replica (`kind="router"`, tags
+    `router-wire:*`) -- the oversized-frame / garbage / idle-reap /
+    in-flight-cap behavior must be identical at both tiers."""
+    w = "wire" if kind == "serve" else "router-wire"
+    print(f"== leg: wire-protocol armor ({kind} front door) ==")
     from pbccs_tpu.serve import protocol
 
     scope = _REG.scope()
-    eng, srv = _stub_server(max_line=4096, idle_s=0.5, cap=2)
+    srv, teardown = _stub_front(kind, max_line=4096, idle_s=0.5, cap=2)
     try:
         # oversized frame -> bad_request, session closed, abort counted
         conn, rf = _session(srv)
         conn.sendall(b"a" * 8192)
         msg = _reply(rf)
-        check(report, "wire:oversized_frame:bad_request",
+        check(report, f"{w}:oversized_frame:bad_request",
               msg is not None and msg.get("code") == "bad_request",
               str(msg)[:80])
-        check(report, "wire:oversized_frame:session_closed",
+        check(report, f"{w}:oversized_frame:session_closed",
               rf.readline() == b"")
         conn.close()
 
@@ -428,10 +468,10 @@ def leg_wire(report: dict) -> None:
         conn, rf = _session(srv)
         conn.sendall(b"\xff\xfe\x00garbage\n")
         msg = _reply(rf)
-        check(report, "wire:binary_garbage:bad_request",
+        check(report, f"{w}:binary_garbage:bad_request",
               msg.get("code") == "bad_request")
         conn.sendall(protocol.encode_msg({"verb": "ping", "id": "p"}))
-        check(report, "wire:binary_garbage:session_survives",
+        check(report, f"{w}:binary_garbage:session_survives",
               _reply(rf).get("type") == "pong")
         conn.close()
 
@@ -450,11 +490,11 @@ def leg_wire(report: dict) -> None:
             conn.sendall(payload)
             msg = _reply(rf)
             if msg.get("code") != "bad_request":
-                check(report, "wire:bad_zmw:rejected", False,
+                check(report, f"{w}:bad_zmw:rejected", False,
                       f"{payload[:40]!r} -> {msg}")
-        check(report, "wire:bad_zmw:rejected", True, "5 payloads")
+        check(report, f"{w}:bad_zmw:rejected", True, "5 payloads")
         conn.sendall(protocol.encode_msg({"verb": "ping", "id": "p"}))
-        check(report, "wire:bad_zmw:session_survives",
+        check(report, f"{w}:bad_zmw:session_survives",
               _reply(rf).get("type") == "pong")
         conn.close()
 
@@ -462,20 +502,19 @@ def leg_wire(report: dict) -> None:
         conn, rf = _session(srv)
         t0 = time.monotonic()
         msg = _reply(rf)  # blocks until the reaper speaks
-        check(report, "wire:idle_session:reaped",
+        check(report, f"{w}:idle_session:reaped",
               msg is not None and msg.get("type") == "closed"
               and msg.get("reason") == "idle_timeout",
               f"after {time.monotonic() - t0:.2f}s")
-        check(report, "wire:idle_session:closed", rf.readline() == b"")
+        check(report, f"{w}:idle_session:closed", rf.readline() == b"")
         conn.close()
     finally:
-        srv.shutdown()
-        eng.close()
+        teardown()
 
     # in-flight cap: gate the polish so submits stack up
     import threading
     gate = threading.Event()
-    eng, srv = _stub_server(cap=2, gate=gate)
+    srv, teardown = _stub_front(kind, cap=2, gate=gate)
     try:
         conn, rf = _session(srv)
         for i in range(3):
@@ -485,25 +524,24 @@ def leg_wire(report: dict) -> None:
                          "reads": [{"seq": "ACGTACGT"}] * 4}}).encode()
                 + b"\n")
         msgs = [_reply(rf) for _ in range(1)]
-        check(report, "wire:inflight_cap:rejected",
+        check(report, f"{w}:inflight_cap:rejected",
               msgs[0].get("code") == "overloaded"
               and "in-flight cap" in msgs[0].get("error", ""),
               str(msgs[0])[:90])
         gate.set()
         done = [_reply(rf) for _ in range(2)]
-        check(report, "wire:inflight_cap:others_complete",
+        check(report, f"{w}:inflight_cap:others_complete",
               all(m and m.get("type") == "result" for m in done))
         conn.close()
     finally:
         gate.set()
-        srv.shutdown()
-        eng.close()
+        teardown()
     aborts = scope.counters("ccs_serve_session_aborts_total")
     causes = {dict(k).get("cause") for k in aborts if aborts[k] > 0}
-    check(report, "wire:aborts_counted",
+    check(report, f"{w}:aborts_counted",
           {"oversized_frame", "idle_timeout"} <= causes,
           f"causes={sorted(causes)}")
-    check(report, "wire:cap_counted", scope.counter_value(
+    check(report, f"{w}:cap_counted", scope.counter_value(
         "ccs_serve_inflight_cap_rejects_total") >= 1)
 
 
@@ -627,11 +665,14 @@ def main(argv=None) -> int:
                 run_bam_case(name, fn, workload, seed_r, tmp, report)
         if args.smoke and args.only is None:
             leg_wire(report)
+            leg_wire(report, kind="router")
             leg_consensus_parity(tmp, report)
             if not args.skip_subprocess:
                 leg_drain(report)
         elif args.only and args.only.startswith("wire:"):
             leg_wire(report)
+        elif args.only and args.only.startswith("router-wire:"):
+            leg_wire(report, kind="router")
         elif args.only == "drain":
             leg_drain(report)
     except CheckFailed as e:

@@ -40,6 +40,9 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fuzz_inputs.py --smoke --se
 echo "== sched smoke (device-fleet scheduler: 8-device scaling + benched-device chaos) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/sched_smoke.py || exit 1
 
+echo "== fleet smoke (serve replicas behind ccs router: kill -9 + drain, zero lost/dup) =="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
