@@ -126,6 +126,14 @@ def _refine_opts():
     return RefineOptions()
 
 
+def _peak_rss() -> int:
+    """Peak host RSS of this process (bytes; rows record it so the
+    spec-scale legs can assert they stayed under --memBudget)."""
+    from pbccs_tpu.resilience.resources import peak_rss_bytes
+
+    return peak_rss_bytes()
+
+
 def run_workload(tasks):
     """One full polish: setup + lockstep refinement + QV sweep."""
     from pbccs_tpu.parallel.batch import BatchPolisher
@@ -273,6 +281,7 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
         "mean_qv": mean_qv,
         "accuracy_draw": "first timed repeat (seed 20260729 draw #2; "
                          "repeat-count-invariant, round-comparable)",
+        "peak_rss_bytes": _peak_rss(),
         "banding": banding,
         **({"device_regions_ms": regions.get("regions", regions),
             "kernel_fraction": regions.get("kernel_fraction")}
@@ -400,6 +409,14 @@ SWEEP_CONFIGS = [
     # reads), half the workload.  The single-batch headline has no
     # inter-batch gaps to hide and stays unoverlapped.
     ("cfg2_2kb_3-10p", 128, 2000, "3-10", 2, 32, 1, {"BENCH_WORKERS": "2"}),
+    # the REAL spec point (BASELINE.json config 2): one 1024-ZMW batch.
+    # Historically avoided because the 2 kb shapes OOMed shared HBM at
+    # large batches; the row runs in its own subprocess, so an OOM here
+    # is an honest per-row error (production dispatch absorbs the same
+    # failure via the resource governor's split path -- see the
+    # full_cell_stream leg), and every row now records its peak RSS.
+    ("cfg2_2kb_3-10p_1024", 1024, 2000, "3-10", 2, 1024, 1,
+     {"BENCH_WORKERS": "1"}),
     ("cfg4_30px500bp", 64, 500, "30", 2, 32, 3, {"BENCH_WORKERS": "2"}),
     # unoverlapped (workers=1) twins of the overlapped rows: speedup-over-
     # reference claims stay apples-to-apples with the single-threaded
@@ -481,6 +498,7 @@ def bench_sweep(ref_cfgs: dict) -> list[dict]:
             "converged": stats["converged"],
             "exact_recoveries": stats["exact_recoveries"],
             "mean_qv": round(stats["mean_qv"], 2),
+            "peak_rss_bytes": stats.get("peak_rss_bytes"),
             "banding": stats.get("banding", {}),
         }
         # kernel-share attribution rides every row that captured one
@@ -1014,6 +1032,119 @@ def bench_streamed(n_zmws: int = 10240, tpl_len: int = 300,
             "e2e_s": round(dt, 2), "stages_s": stages, "yield": rows}
 
 
+def bench_full_cell(n_zmws: int | None = None, tpl_len: int = 300,
+                    n_passes: str = "8", n_corr: int = 2,
+                    chunk: int = 128) -> dict:
+    """The spec-scale endurance point (BASELINE.json config 5 at FULL
+    scale, ROADMAP item 4): a >=150k-ZMW simulated SMRT cell streamed
+    FASTA -> BAM through the FLEET scheduler with checkpointing enabled
+    and a host-memory budget armed.  The row records peak RSS against
+    the budget and every resource-governor intervention (OOM splits,
+    learned ceilings, admission pre-splits, budget throttles) -- the
+    figures the resource-governance layer is judged by on a sustained
+    run.  BENCH_CELL_ZMWS scales the cell down for CPU shakeouts;
+    BENCH_MEM_BUDGET sets the budget (default 8G)."""
+    import tempfile
+
+    import numpy as np
+
+    from pbccs_tpu import cli
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.obs.metrics import default_registry
+    from pbccs_tpu.resilience.resources import parse_size
+
+    if n_zmws is None:
+        n_zmws = int(os.environ.get("BENCH_CELL_ZMWS", 153_600))
+    mem_budget = os.environ.get("BENCH_MEM_BUDGET", "8G")
+    rng = np.random.default_rng(20260729)
+    tmp = tempfile.mkdtemp(prefix="pbccs_cell_")
+    try:
+        # the workload streams to disk in chunk-size slices: a 150k-ZMW
+        # in-memory task list would itself blow the budget under test
+        full_fa = os.path.join(tmp, "cell.fasta")
+        with open(full_fa, "w") as f:
+            for lo in range(0, n_zmws, chunk):
+                tasks, _ = build_tasks(rng, min(chunk, n_zmws - lo),
+                                       tpl_len, n_passes, n_corr)
+                for t in tasks:
+                    hole = int(t.id.split("/")[1]) + lo
+                    start = 0
+                    for read in t.reads:
+                        seq = decode_bases(read)
+                        f.write(f">cell/{hole}/{start}_"
+                                f"{start + len(seq)}\n{seq}\n")
+                        start += len(seq) + 50
+        argv = [os.path.join(tmp, "cell.bam"), full_fa,
+                "--skipChemistryCheck", "--chunkSize", str(chunk),
+                "--devices", "0", "--memBudget", mem_budget,
+                "--checkpoint", os.path.join(tmp, "cell.ckpt"),
+                "--reportFile", os.path.join(tmp, "cell.csv"),
+                "--zmws", "all"]
+        scope = default_registry().scope()
+        # in-run RSS sampling: ru_maxrss is process-LIFETIME peak and the
+        # sweep runs other in-process legs first, so only a sampled
+        # during-the-run maximum honestly answers "did THIS run stay
+        # under --memBudget"
+        import threading
+
+        from pbccs_tpu.resilience.resources import rss_bytes
+
+        run_peak = [0]
+        stop_sampler = threading.Event()
+
+        def _sample_rss():
+            while not stop_sampler.is_set():
+                run_peak[0] = max(run_peak[0], rss_bytes())
+                stop_sampler.wait(0.25)
+
+        sampler = threading.Thread(target=_sample_rss, daemon=True)
+        sampler.start()
+        t0 = time.monotonic()
+        try:
+            rc = cli.run(argv)
+        finally:
+            stop_sampler.set()
+            sampler.join(timeout=5.0)
+        dt = time.monotonic() - t0
+        assert rc == 0, f"full-cell run exited {rc}"
+        rows = {}
+        with open(os.path.join(tmp, "cell.csv")) as f:
+            for line in f:     # headerless "label,count,pct" rows
+                parts = line.strip().split(",")
+                if len(parts) == 3:
+                    rows[parts[0]] = int(parts[1])
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    peak = run_peak[0] or _peak_rss()
+    budget_bytes = parse_size(mem_budget)
+    return {
+        "name": "full_cell_stream", "n_zmws": n_zmws,
+        "tpl_len": tpl_len, "n_passes": n_passes, "chunk": chunk,
+        "checkpoint": True,
+        "ccs_zmws_per_sec": round(n_zmws / dt, 4),
+        "e2e_s": round(dt, 2),
+        "mem_budget": mem_budget,
+        "peak_rss_bytes": peak,              # sampled DURING the run
+        "peak_rss_lifetime_bytes": _peak_rss(),
+        "peak_rss_under_budget": peak <= budget_bytes,
+        "governor": {
+            "oom_splits": scope.counter_value(
+                "ccs_resource_oom_splits_total"),
+            "oom_ceilings": scope.counter_value(
+                "ccs_resource_oom_ceilings_total"),
+            "admission_presplits": scope.counter_value(
+                "ccs_resource_presplit_batches_total"),
+            "budget_throttles": scope.counter_value(
+                "ccs_resource_throttles_total", site="sched.prepare"),
+            "checkpoint_records": scope.counter_value(
+                "ccs_checkpoint_records_total", kind="written"),
+        },
+        "yield": rows,
+    }
+
+
 def main() -> None:
     record_baseline = "--record-cpu-baseline" in sys.argv
     if record_baseline:
@@ -1068,8 +1199,8 @@ def main() -> None:
             with open(BASELINE_FILE) as f:
                 ref_cfgs = json.load(f).get("configs", {})
         configs = bench_sweep(ref_cfgs)
-        for extra in (bench_quiver, bench_streamed, bench_sched,
-                      bench_router, bench_warm_restart):
+        for extra in (bench_quiver, bench_streamed, bench_full_cell,
+                      bench_sched, bench_router, bench_warm_restart):
             try:
                 configs.append(extra())
             except Exception as e:  # noqa: BLE001

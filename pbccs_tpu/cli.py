@@ -30,7 +30,7 @@ from pbccs_tpu.io.bam import (
     make_read_group_id,
 )
 from pbccs_tpu.io.fasta import flatten_fofn, read_fasta
-from pbccs_tpu.io.report import write_results_report
+from pbccs_tpu.io.report import write_report_file as write_results_report_file
 from pbccs_tpu.models.arrow.params import encode_bases
 from pbccs_tpu.pipeline import (
     Chunk,
@@ -177,6 +177,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "journal and compute only the rest; the final "
                         "tally and output are identical to an "
                         "uninterrupted run.")
+    p.add_argument("--memBudget", default=None, metavar="SIZE",
+                   help="Host-memory budget for batch backlog in the "
+                        "fleet driver (--devices != 1), e.g. 8G or "
+                        "512M: the prepare pool throttles (visible as "
+                        "ccs_resource_throttles_total, never a crash) "
+                        "while prepared-batch bytes in flight would "
+                        "exceed it.  Default: unbounded.")
     p.add_argument("--batchFallback", choices=("bisect", "serial"),
                    default="bisect",
                    help="Recovery when a lockstep polish batch fails: "
@@ -378,6 +385,20 @@ def run(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
+    if args.memBudget is not None:
+        from pbccs_tpu.resilience.resources import parse_size
+
+        try:
+            args.memBudget = parse_size(args.memBudget)
+            if args.memBudget < 1:
+                # '0' / '0.5' parse but HostBudget would reject them
+                # mid-run; surface the usage error before reading input
+                raise ValueError(
+                    f"must be >= 1 byte, got {args.memBudget}")
+        except ValueError as e:
+            print(f"option --memBudget: {e}", file=sys.stderr)
+            return 2
+
     settings = consensus_settings_from_args(args)
 
     files = flatten_fofn(args.files)
@@ -403,9 +424,24 @@ def run(argv: list[str] | None = None) -> int:
             log.warn("--trace-out ignored: another span capture is "
                      "already running in this process")
             tracer = None
+    from pbccs_tpu.resilience.resources import OutputWriteError
+
     try:
         with profiling.profile_capture(args.profile_dir):
             _run_pipeline(args, files, whitelist, settings, log)
+    except OutputWriteError as e:
+        # a full disk is an OPERATIONAL failure, not a bug: report what
+        # was durably written and how to resume, exit nonzero without a
+        # traceback.  The checkpoint journal (if any) keeps every
+        # completed chunk, so a rerun with --resume after freeing space
+        # completes byte-identically.
+        log.error(f"output failure: {e}")
+        print(f"ccs: {e}\n"
+              "ccs: free disk space and re-run (add --resume to restore "
+              "completed chunks from the checkpoint journal)",
+              file=sys.stderr)
+        log.flush()
+        return 1
     finally:
         if tracer is not None:
             obs_trace.clear_tracer(tracer)
@@ -542,11 +578,20 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
         # dropped (an explicit --prepareWorkers still wins)
         prep_workers = args.prepareWorkers or args.numThreads or max(
             2, min(4, os.cpu_count() or 1))
+        # --memBudget: byte-bound the prepared-batch backlog (prep pool
+        # + parked results) so a full-cell stream cannot outrun the
+        # devices into the OOM killer (resilience.resources.HostBudget)
+        budget = None
+        if args.memBudget is not None:
+            from pbccs_tpu.resilience.resources import HostBudget
+
+            budget = HostBudget(args.memBudget, logger=log)
         pool = DevicePool(devs, DevicePoolConfig(policy=args.schedPolicy),
                           logger=log)
         pipe = ScheduledPipeline(pool, settings,
                                  prepare_workers=prep_workers,
-                                 on_error=args.batchFallback, logger=log)
+                                 on_error=args.batchFallback,
+                                 budget=budget, logger=log)
 
         # the reader runs on the pipeline's feeder thread, so its
         # CLI-gate skips tally into their own ResultTally (merged below)
@@ -570,6 +615,12 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
         pool.close()
         tally.merge(gate_tally)
     else:
+        if args.memBudget is not None:
+            log.warn("--memBudget gates the fleet driver's prepare "
+                     "backlog; the single-device WorkQueue driver "
+                     "(--devices 1) is already bounded by --numThreads "
+                     "work items, so the flag is ignored here")
+
         def _run_batch(idx, batch):
             return idx, process_chunks(batch, settings,
                                        on_error=args.batchFallback)
@@ -604,11 +655,6 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
         if consumer_error:
             raise consumer_error[0]
         tally.merge(consumed)
-    if journal is not None:
-        # a completed run needs no resume point; a later --resume against
-        # fresh inputs must not splice stale results
-        journal.remove()
-
     log.info(f"processed {tally.total} ZMWs: "
              f"{tally.counts[Failure.SUCCESS]} successes")
 
@@ -626,6 +672,7 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
         # companion .pbi, as the reference's PbiBuilder does alongside the
         # output BAM (reference src/main/ccs.cpp:120, 380)
         from pbccs_tpu.io.pbi import PbiBuilder, read_group_numeric_id
+        from pbccs_tpu.resilience.resources import OutputWriteError
         uposs = []
         with obs_trace.span("emit", results=len(tally.results)), \
                 timing.stage("write"):
@@ -633,18 +680,37 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
                 for result in tally.results:
                     uposs.append(bw.write(writer_record(result)))
                 bw_handle = bw
-            with PbiBuilder(args.output + ".pbi") as pbi:
-                for result, upos in zip(tally.results, uposs):
-                    movie = result.id.split("/")[0]
-                    hole = int(result.id.split("/")[1])
-                    pbi.add_record(
-                        read_group_numeric_id(
-                            make_read_group_id(movie, "CCS")),
-                        -1, -1, hole, result.predicted_accuracy, 0,
-                        bw_handle.voffset(upos))
+            # same atomicity contract as the BAM: build the index in a
+            # same-dir temp file and rename into place, so an ENOSPC
+            # mid-index never publishes a torn .pbi beside a valid BAM
+            pbi_path = args.output + ".pbi"
+            try:
+                with PbiBuilder(pbi_path + ".tmp") as pbi:
+                    for result, upos in zip(tally.results, uposs):
+                        movie = result.id.split("/")[0]
+                        hole = int(result.id.split("/")[1])
+                        pbi.add_record(
+                            read_group_numeric_id(
+                                make_read_group_id(movie, "CCS")),
+                            -1, -1, hole, result.predicted_accuracy, 0,
+                            bw_handle.voffset(upos))
+                os.replace(pbi_path + ".tmp", pbi_path)
+            except OSError as e:
+                try:
+                    os.remove(pbi_path + ".tmp")
+                except OSError:
+                    pass  # best-effort cleanup; the .tmp suffix marks it
+                raise OutputWriteError("pbi", pbi_path, 0, e) from e
 
-    with open(args.reportFile, "w") as rf:
-        write_results_report(rf, tally)
+    write_results_report_file(args.reportFile, tally)
+    if journal is not None:
+        # only a run whose OUTPUTS landed needs no resume point: a
+        # disk-full failure writing the BAM/report above keeps the
+        # journal, so --resume restores every completed chunk and
+        # re-emits byte-identically once space is freed.  (A later
+        # --resume against fresh inputs still cannot splice stale
+        # results -- the fingerprint refuses it.)
+        journal.remove()
     return tally
 
 

@@ -698,34 +698,104 @@ def _decode_record(body: bytes, policy: str) -> BamRecord:
 
 
 class BamWriter:
-    """Unaligned BAM writer (no reference sequences)."""
+    """Unaligned BAM writer (no reference sequences).
+
+    Disk-full safe (resilience.resources): records stream to
+    ``path + ".tmp"`` and the finished file renames into place at
+    close(), so a crash or ENOSPC mid-run never publishes a torn BAM
+    under the output path.  A failed filesystem write (short write,
+    ENOSPC, quota) raises a structured ``OutputWriteError`` with
+    bytes-written accounting and removes the temp file; re-running the
+    emission (e.g. ``--resume`` after freeing space) produces a
+    byte-identical file.  The ``output.write`` fault site (keys:
+    ``bam``, path) lets chaos runs inject the failure deterministically.
+    """
 
     def __init__(self, path: str, header: BamHeader):
-        self._fh = open(path, "wb")
+        from pbccs_tpu.resilience.resources import OutputWriteError
+
+        self.path = path
+        self._tmp = path + ".tmp"
+        self._finalized = False
+        try:
+            self._fh = open(self._tmp, "wb")
+        except OSError as e:
+            raise OutputWriteError("bam", path, 0, e) from e
         self._bgzf = BgzfWriter(self._fh)
         text = header.to_text().encode()
-        self._bgzf.write(b"BAM\x01" + struct.pack("<i", len(text)) + text
-                         + struct.pack("<i", 0))
+        self._guard(lambda: self._bgzf.write(
+            b"BAM\x01" + struct.pack("<i", len(text)) + text
+            + struct.pack("<i", 0)))
+
+    def _guard(self, fn):
+        """Run one write step under the fault site; an OSError discards
+        the temp file and surfaces as a structured OutputWriteError
+        carrying the compressed bytes the sink durably accepted."""
+        from pbccs_tpu.resilience import faults
+        from pbccs_tpu.resilience.resources import OutputWriteError
+
+        try:
+            faults.maybe_fail("output.write", keys=["bam", self.path])
+            return fn()
+        except OSError as e:
+            written = self._bgzf._cpos
+            self.discard()
+            raise OutputWriteError("bam", self.path, written, e) from e
 
     def write(self, rec: BamRecord) -> int:
         """Write one record; returns its uncompressed stream offset (resolve
         to a .pbi virtual file offset with `voffset()` after close)."""
         upos = self._bgzf.utell()
-        self._bgzf.write(encode_record(rec))
+        self._guard(lambda: self._bgzf.write(encode_record(rec)))
         return upos
 
     def voffset(self, upos: int) -> int:
         return self._bgzf.voffset(upos)
 
     def close(self) -> None:
-        self._bgzf.close()
-        self._fh.close()
+        """Finalize: flush + fsync the temp file, then atomically rename
+        it under the output path (the publish step; a reader never sees
+        a torn BAM)."""
+        if self._finalized:
+            return
+
+        def finish():
+            self._bgzf.close()
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            os.replace(self._tmp, self.path)
+
+        self._guard(finish)
+        self._finalized = True
+
+    def discard(self) -> None:
+        """Abandon the output without publishing (error-path teardown):
+        closes and removes the temp file, leaving any previous file at
+        the output path untouched."""
+        if self._finalized:
+            return
+        self._finalized = True
+        try:
+            self._fh.close()
+        except OSError:
+            pass  # already failing; nothing actionable from a close error
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass  # best-effort cleanup; the .tmp suffix marks it torn
 
     def __enter__(self) -> "BamWriter":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, *exc) -> None:
+        # an exception in the `with` body means the record stream is
+        # incomplete: discard the temp file rather than publishing a
+        # short (but well-formed-looking) BAM under the output path
+        if exc_type is not None:
+            self.discard()
+        else:
+            self.close()
 
 
 def _scan_candidates(buf: bytes, limit: int):

@@ -30,3 +30,17 @@ def write_results_report(out: TextIO, tally: ResultTally) -> None:
             continue  # the reference has no Other line; only emit if nonzero
         count = tally.counts[failure]
         out.write(f"{label},{count},{100.0 * count / total:.2f}%\n")
+
+
+def write_report_file(path: str, tally: ResultTally) -> None:
+    """Disk-full-safe report write: the CSV lands through a same-dir
+    temp file + rename (resilience.resources.atomic_output), so an
+    ENOSPC mid-write surfaces as a structured OutputWriteError and
+    never publishes a torn report.  The ``output.write`` fault site
+    (key ``report``) injects the failure deterministically."""
+    from pbccs_tpu.resilience import faults
+    from pbccs_tpu.resilience.resources import atomic_output
+
+    with atomic_output(path, "report") as out:
+        faults.maybe_fail("output.write", keys=["report", path])
+        write_results_report(out, tally)

@@ -194,6 +194,22 @@ class PrebakedBatch:
     host_tables: np.ndarray
 
 
+def premarshal_nbytes(shapes: tuple[int, int, int, int]) -> int:
+    """Host bytes a premarshal() of these effective (Imax, Jmax, R, Z)
+    shapes holds -- the per-batch charge the resource governor's
+    HostBudget gates the prepare pool on (resilience.resources).  Sums
+    the marshalled planes exactly (reads int8 dominates); the ZmwTask
+    arrays themselves are references into the reader's buffers and are
+    bounded separately by the pipeline's in-flight count."""
+    imax, _jmax, r, z = shapes
+    return (z * r * imax          # reads int8
+            + 4 * z * r * 4       # rlens/strands/tstarts/tends int32
+            + z * 4 * 8           # snrs float64
+            + z * 4               # n_reads int32
+            + z * r               # real_rows bool
+            + z * 8 * 4 * 4)      # host_tables float32 (8, 4) per ZMW
+
+
 def premarshal(tasks: Sequence[ZmwTask], *,
                buckets: tuple[int, int, int] | None = None,
                min_z: int = 1, zq: int = 1, rq: int = 1) -> PrebakedBatch:

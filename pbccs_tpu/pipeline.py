@@ -750,9 +750,18 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
     the quarantine path.
 
     `prebaked`: a PrebakedBatch from prebake_polish (built on a prepare
-    worker) adopted by the full-batch dispatch only -- quarantine
-    sub-dispatches and serial rescues always re-marshal their own
-    subsets, so fault recovery is unchanged."""
+    worker) adopted by the full-batch dispatch only -- quarantine and
+    OOM-split sub-dispatches and serial rescues always re-marshal their
+    own subsets, so fault recovery is unchanged.
+
+    Capacity governance (resilience.resources): a capacity-shaped
+    failure (device OOM / RESOURCE_EXHAUSTED) is NEVER retried at the
+    same shape and NEVER quarantined -- the batch splits Z -> Z/2
+    through the same bucket-pinned sub-dispatch machinery quarantine
+    uses (shapes pinned, so survivors stay byte-identical) and the
+    MemoryGovernor records a shape ceiling, so later batches for the
+    bucket are pre-split at admission instead of re-discovering the
+    OOM."""
     settings = settings or ConsensusSettings()
     if settings.model == "quiver":
         # Quiver has no lockstep batch driver: it polishes per ZMW (its
@@ -765,12 +774,104 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
                 record_zmw_failure("polish.quiver", e, zmw=p.chunk.id)
                 out.append((Failure.OTHER, None))
         return out
+    from pbccs_tpu.resilience import resources
+
+    pin, z_pin = _pinned_batch_shapes(preps, buckets, min_z)
+    cap = resources.default_governor().cap(
+        resources.shape_bucket(*pin), device=resources.current_device())
+    if cap is not None and len(preps) > cap:
+        # admission pre-split: the governor already learned this bucket
+        # OOMs past `cap` ZMWs on this device -- dispatch ceiling-sized
+        # parts (pinned to the parent shapes, so results match the
+        # unsplit batch byte for byte) instead of paying the OOM again
+        resources.note_presplit()
+        Logger.default().info(
+            f"memory governor: pre-splitting batch of {len(preps)} "
+            f"ZMW(s) at ceiling {cap} (bucket {pin})")
+        out = []
+        start = 0
+        for size in resources.split_sizes(len(preps), cap):
+            out.extend(_polish_split_part(
+                preps[start:start + size], settings, pin,
+                on_error=on_error,
+                raise_device_shaped=raise_device_shaped))
+            start += size
+        return out
+    return _polish_guarded(preps, settings, buckets=buckets, min_z=min_z,
+                           pin=pin, z_pin=z_pin, on_error=on_error,
+                           raise_device_shaped=raise_device_shaped,
+                           prebaked=prebaked)
+
+
+def _polish_split_part(preps: Sequence[PreparedZmw],
+                       settings: ConsensusSettings, pin, *,
+                       on_error: str, raise_device_shaped: bool
+                       ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """One OOM-split part: pinned to the parent's (Imax, Jmax, R)
+    bucket (byte-identity) with its OWN pow2 Z (the smaller Z IS the
+    memory relief), full recovery semantics (further capacity splits,
+    quarantine, serial rescue) intact."""
+    from pbccs_tpu.utils import next_pow2
+
+    z = next_pow2(len(preps), 1)
+    return _polish_guarded(preps, settings, buckets=pin, min_z=z,
+                           pin=pin, z_pin=z, on_error=on_error,
+                           raise_device_shaped=raise_device_shaped,
+                           prebaked=None)
+
+
+def _capacity_split(preps: Sequence[PreparedZmw],
+                    settings: ConsensusSettings, pin, *,
+                    on_error: str, raise_device_shaped: bool,
+                    exc: BaseException
+                    ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """Recovery from a capacity-shaped dispatch failure at batch size Z:
+    record the governor ceiling (Z // 2 for this device + bucket) and
+    re-dispatch the two halves at the pinned bucket shapes.  A singleton
+    that alone exceeds the device gets the serial host-path rescue (its
+    last chance to fit), then quarantines -- never a same-shape retry
+    loop, never a bisection tour over healthy ZMWs."""
+    from pbccs_tpu.resilience import quarantine, resources
+
+    record_zmw_failure("polish.capacity", exc,
+                       zmw=f"batch[{len(preps)}]")
+    resources.default_governor().record_oom(
+        resources.shape_bucket(*pin), len(preps))
+    if len(preps) == 1:
+        return [quarantine.serial_rescue(preps[0], settings, exc)]
+    resources.note_oom_split()
+    mid = len(preps) // 2
+    out: list[tuple[Failure, ConsensusResult | None]] = []
+    for sub in (preps[:mid], preps[mid:]):
+        out.extend(_polish_split_part(
+            sub, settings, pin, on_error=on_error,
+            raise_device_shaped=raise_device_shaped))
+    return out
+
+
+def _polish_guarded(preps: Sequence[PreparedZmw],
+                    settings: ConsensusSettings, *,
+                    buckets: tuple[int, int, int] | None, min_z: int,
+                    pin, z_pin: int, on_error: str,
+                    raise_device_shaped: bool, prebaked
+                    ) -> list[tuple[Failure, ConsensusResult | None]]:
+    """One guarded dispatch with the full failure-taxonomy recovery:
+    capacity-shaped -> adaptive split (checked FIRST -- an OOM must
+    never reach the device-shaped re-raise or the quarantine tour),
+    device-shaped -> optional re-raise for the fleet scheduler,
+    task-shaped -> quarantine bisection / legacy serial fallback."""
     try:
         return _guarded_dispatch(preps, settings, buckets=buckets,
                                  min_z=min_z, prebaked=prebaked)
-    except Exception as e:  # noqa: BLE001 -- quarantine the poison
-        from pbccs_tpu.resilience import quarantine, retry, watchdog
+    except Exception as e:  # noqa: BLE001 -- classified below
+        from pbccs_tpu.resilience import quarantine, resources, retry, \
+            watchdog
 
+        if resources.is_capacity_error(e):
+            return _capacity_split(preps, settings, pin,
+                                   on_error=on_error,
+                                   raise_device_shaped=raise_device_shaped,
+                                   exc=e)
         if raise_device_shaped and (
                 isinstance(e, (watchdog.WatchdogTimeout,
                                retry.RetriesExhausted))
@@ -784,7 +885,6 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
                                zmw=f"batch[{len(preps)}]")
             return [quarantine.serial_rescue(p, settings, e)
                     for p in preps]
-        pin, z_pin = _pinned_batch_shapes(preps, buckets, min_z)
         return quarantine.isolate(
             preps,
             lambda sub: _guarded_dispatch(sub, settings, buckets=pin,

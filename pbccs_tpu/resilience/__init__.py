@@ -20,10 +20,18 @@ re-engineered at batch granularity:
                     path; serve: failed replies, engine stays up);
   * `checkpoint` -- per-chunk journal for the offline CLI (`--resume`):
                     a killed run restarts from the last completed chunk
-                    with an identical final tally and output.
+                    with an identical final tally and output;
+  * `resources`  -- resource-exhaustion governance: capacity-shaped
+                    failure classification (device OOM != transient !=
+                    poison), the MemoryGovernor's learned per-device
+                    shape ceilings behind OOM-adaptive batch splitting,
+                    the HostBudget gate behind --memBudget, and
+                    disk-full-safe output finalization
+                    (OutputWriteError + atomic tmp+rename).
 
 Metric names (obs registry): ccs_faults_injected_total{site,kind},
 ccs_retries_total{site}, ccs_quarantined_zmws_total,
 ccs_degraded_zmws_total, ccs_watchdog_timeouts_total{site},
-ccs_checkpoint_records_total{kind}, ccs_zmw_failures_total{stage,exc}.
+ccs_checkpoint_records_total{kind}, ccs_zmw_failures_total{stage,exc},
+ccs_resource_*, ccs_output_write_errors_total{sink}.
 """

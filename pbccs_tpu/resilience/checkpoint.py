@@ -23,7 +23,12 @@ Robustness of the journal itself:
     settings; a mismatch (different inputs/flags) refuses the resume and
     starts fresh rather than splicing incompatible results;
   * NaN float fields (z-scores) survive the round trip (Python's JSON
-    emits and parses NaN).
+    emits and parses NaN);
+  * a full disk (ENOSPC / short write) mid-append raises a structured
+    resources.OutputWriteError with bytes-written accounting instead of
+    an unhandled traceback; the journal keeps every complete record,
+    start(resume=True) trims the torn tail before appending, and the
+    rerun completes byte-identically once space is freed.
 
 Metrics: ccs_checkpoint_records_total{kind=written|restored|corrupt}.
 """
@@ -197,15 +202,52 @@ class CheckpointJournal:
 
     def start(self, fingerprint: dict[str, Any], resume: bool) -> None:
         """Open for appending.  A fresh (non-resume) run truncates; a
-        resume appends new chunk records after the existing ones (the
-        loader takes the last record per index, so re-journaling is
-        harmless)."""
+        resume first TRIMS any torn final line (a kill -9 or ENOSPC
+        mid-record leaves a partial line with no newline -- appending a
+        new record after it would concatenate the two into one corrupt
+        line and lose BOTH chunks), then appends new chunk records
+        after the existing ones (the loader takes the last record per
+        index, so re-journaling is harmless)."""
         mode = "ab" if (resume and os.path.exists(self.path)) else "wb"
+        if mode == "ab":
+            self._trim_torn_tail()
         self._fh = open(self.path, mode)
         if mode == "wb" or os.path.getsize(self.path) == 0:
             self._write_line({"type": "header",
                               "version": JOURNAL_VERSION,
                               "fingerprint": fingerprint})
+
+    def _trim_torn_tail(self) -> None:
+        """Truncate the journal back to its last complete line (the
+        torn-tail-tolerant half of the resume contract: load() already
+        DROPS the torn record; this makes the file safe to append to)."""
+        try:
+            with open(self.path, "rb+") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                if size == 0:
+                    return
+                fh.seek(size - 1)
+                if fh.read(1) == b"\n":
+                    return
+                keep, pos = 0, size
+                while pos > 0:
+                    step = min(1 << 16, pos)
+                    fh.seek(pos - step)
+                    nl = fh.read(step).rfind(b"\n")
+                    if nl >= 0:
+                        keep = pos - step + nl + 1
+                        break
+                    pos -= step
+                fh.truncate(keep)
+            _m_records["corrupt"].inc()
+            self._log.warn(
+                f"resume: trimmed {size - keep} byte(s) of torn record "
+                f"off the journal tail at {self.path}; that chunk will "
+                "be recomputed")
+        except OSError as e:
+            # the append-mode open below will surface a real I/O problem
+            self._log.warn(f"resume: could not trim journal tail: {e}")
 
     def record_chunk(self, index: int, tally) -> None:
         """Journal one completed chunk (fsynced: survives kill -9)."""
@@ -217,12 +259,41 @@ class CheckpointJournal:
 
     def _write_line(self, rec: dict[str, Any]) -> None:
         from pbccs_tpu.resilience import faults
+        from pbccs_tpu.resilience.resources import OutputWriteError
 
         data = (json.dumps(rec) + "\n").encode()
         data = faults.corrupt("checkpoint.record", data)
-        self._fh.write(data)
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        try:
+            pre = self._fh.tell()
+        except (OSError, ValueError):
+            pre = 0
+        try:
+            # enospc-kind injection fires here: the exact OSError a full
+            # disk raises, exercising the structured-error + torn-tail
+            # resume path end to end
+            faults.maybe_fail("checkpoint.record",
+                              keys=[str(rec.get("type", "")),
+                                    str(rec.get("index", ""))])
+            self._fh.write(data)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            # `pre` = bytes durably on disk BEFORE this record: the
+            # prefix the torn-tail-tolerant loader can still use
+            written = pre
+            # drop the handle but KEEP the journal: every complete
+            # record in it restores on the next --resume once space is
+            # freed (the torn tail, if any, trims then).  The close is
+            # guarded: a BufferedWriter.close() re-flushes its tail and
+            # re-raises the same ENOSPC, which would replace THIS
+            # structured error with a raw traceback.
+            fh, self._fh = self._fh, None
+            try:
+                fh.close()
+            except OSError:
+                pass  # the buffered tail is already accounted lost
+            raise OutputWriteError("checkpoint", self.path, written,
+                                   e) from e
 
     def close(self) -> None:
         if self._fh is not None:

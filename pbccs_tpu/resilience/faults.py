@@ -20,6 +20,13 @@ Spec grammar (comma-separated entries):
     kind   error     raise InjectedFault (arg = message marker; the
                      marker "transient" makes retry.is_transient_device_error
                      treat it as retryable)
+           oom       raise InjectedFault carrying the RESOURCE_EXHAUSTED
+                     marker: classified CAPACITY-shaped
+                     (resources.is_capacity_error), exercising the
+                     OOM-adaptive split path at dispatch sites
+           enospc    raise OSError(ENOSPC) -- the real exception class a
+                     full disk produces, so writer sites exercise their
+                     production error handling, not a chaos special case
            delay     sleep arg seconds (a hang, for the watchdog)
            corrupt   mutate the payload passed to corrupt() at the site
     ~key   fire only when one of the caller's keys equals `key`
@@ -34,6 +41,9 @@ Examples:
     polish.dispatch:delay=30@1           # first dispatch hangs 30 s
     polish.dispatch:error=transient@1*1  # one retryable device error
     checkpoint.record:corrupt@2          # torn journal record
+    sched.dispatch:oom@1*1               # one device OOM -> split
+    checkpoint.record:enospc@3*1         # disk fills at record 3
+    output.write:enospc~bam@1*1          # BAM writer hits a full disk
 
 Enable via environment (read once, on first site hit):
 
@@ -119,10 +129,10 @@ def parse_faults(text: str) -> list[FaultSpec]:
                     f"bad fault modifier {mark}{val!r} in {raw!r}"
                 ) from None
         kind, _, arg = rest.partition("=")
-        if kind not in ("error", "delay", "corrupt"):
+        if kind not in ("error", "delay", "corrupt", "oom", "enospc"):
             raise FaultSpecError(
                 f"bad fault kind {kind!r} in {raw!r} "
-                "(want error|delay|corrupt)")
+                "(want error|delay|corrupt|oom|enospc)")
         specs.append(FaultSpec(site=site, kind=kind, arg=arg, **spec_kw))
     return specs
 
@@ -190,7 +200,19 @@ class FaultInjector:
         if delay > 0.0:
             time.sleep(delay)
         if boom is not None:
-            raise InjectedFault(site, boom.arg)
+            if boom.kind == "enospc":
+                # the REAL exception class a full disk produces, so the
+                # armed writer site exercises its production OSError
+                # handling end to end (structured OutputWriteError,
+                # atomic-tmp cleanup, torn-tail resume)
+                import errno
+
+                raise OSError(errno.ENOSPC,
+                              f"No space left on device (injected at "
+                              f"{site})")
+            marker = ("RESOURCE_EXHAUSTED" if boom.kind == "oom"
+                      else boom.arg)
+            raise InjectedFault(site, marker)
 
     def corrupt(self, site: str, data, keys: Sequence[str] = ()):
         """Return `data`, corrupted if a corrupt spec fires for `site`.

@@ -5,9 +5,11 @@ devices.  Two call sites define the contract:
 
   * the serve client retries `overloaded` rejections (bounded attempts,
     jittered backoff so a thundering herd decorrelates);
-  * device dispatch retries TRANSIENT XLA errors (allocator pressure,
-    preempted/unavailable device) before the quarantine machinery treats
-    the batch as poisoned.
+  * device dispatch retries TRANSIENT XLA errors (preempted/unavailable
+    device) before the quarantine machinery treats the batch as
+    poisoned.  Memory exhaustion is NOT transient -- it is
+    capacity-shaped (resources.is_capacity_error) and handled by the
+    OOM-adaptive split path, never a same-shape retry.
 
 Jitter is drawn from a seedable RNG so chaos runs are reproducible; the
 optional deadline bounds total wall time INCLUDING the next sleep (a
@@ -117,16 +119,25 @@ OVERLOADED_RETRY = RetryPolicy(max_attempts=128, base_delay_s=0.05,
 
 # message markers identifying a transient device-side failure.  XLA wraps
 # everything in XlaRuntimeError; the status code survives in the text.
-_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED",
-                      "DEADLINE_EXCEEDED", "transient")
+# RESOURCE_EXHAUSTED is deliberately NOT here: a device OOM is
+# CAPACITY-shaped (resources.is_capacity_error) -- retrying the
+# identical batch shape cannot succeed, so the recovery is an adaptive
+# split, never a same-shape retry loop that ends in RetriesExhausted
+# quarantining a healthy batch.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "ABORTED", "DEADLINE_EXCEEDED",
+                      "transient")
 
 
 def is_transient_device_error(exc: BaseException) -> bool:
     """True when exc looks like a retryable device/runtime hiccup rather
-    than a poison input or a code bug.  Matches by type name (jaxlib's
-    XlaRuntimeError is not importable from a stable path) + by status
-    marker in the message, so injected faults with a "transient" marker
-    classify identically to the real thing."""
+    than a poison input, a code bug, or memory exhaustion.  Matches by
+    type name (jaxlib's XlaRuntimeError is not importable from a stable
+    path) + by status marker in the message, so injected faults with a
+    "transient" marker classify identically to the real thing."""
+    from pbccs_tpu.resilience.resources import is_capacity_error
+
+    if is_capacity_error(exc):
+        return False
     name = type(exc).__name__
     text = str(exc)
     if any(m in text for m in _TRANSIENT_MARKERS):

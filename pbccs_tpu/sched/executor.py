@@ -44,6 +44,7 @@ class ScheduledPipeline:
                  settings: "pipeline.ConsensusSettings",
                  prepare_workers: int = 2, on_error: str = "bisect",
                  max_inflight: int | None = None,
+                 budget=None,
                  logger: Logger | None = None):
         self.pool = pool
         self.settings = settings
@@ -54,6 +55,15 @@ class ScheduledPipeline:
         # cannot buffer a whole cell's preps in memory
         self.max_inflight = max_inflight or (
             self.prepare_workers + pool.n_devices + 2)
+        # optional resources.HostBudget (--memBudget): each batch charges
+        # its marshalled-bytes estimate before the prebake builds and
+        # releases when its POLISH completes -- the true lifetime of the
+        # charged planes (they are garbage once the dispatch consumed
+        # them), and a release point that cannot deadlock: emission is
+        # strictly ordered, so a release tied to emission could wait on
+        # an earlier batch whose prep is itself blocked in admit().
+        # Parked results stay count-bounded by max_inflight.
+        self.budget = budget
         self._log = logger or Logger.default()
 
     # Each input item is (index, chunks, precomputed) -- precomputed is a
@@ -73,10 +83,15 @@ class ScheduledPipeline:
                 done[seq] = payload
                 cv.notify_all()
 
-        def polish_done(seq, idx, tally, preps, fut) -> None:
+        def polish_done(seq, idx, tally, preps, fut, lease=None) -> None:
             # runs as a SchedFuture callback, whose exceptions the pool
             # only debug-logs: anything raising here must still finish()
             # this slot or run()'s ordered emission waits forever
+            if lease is not None:
+                # the polish consumed (or abandoned) the marshalled
+                # planes; their budget charge ends here regardless of
+                # outcome (release is idempotent)
+                lease.release()
             try:
                 exc = fut.exception()
                 if exc is not None:
@@ -102,6 +117,7 @@ class ScheduledPipeline:
                 finish(seq, e)
 
         def prep_one(seq: int, idx: int, chunks, precomputed) -> None:
+            lease = None
             try:
                 if precomputed is not None:
                     finish(seq, (idx, precomputed))
@@ -113,6 +129,21 @@ class ScheduledPipeline:
                 (imax, jmax, r), z = pipeline._pinned_batch_shapes(
                     preps, None, 1)
                 key = (jmax, imax, r, z)
+                # host-budget gate (--memBudget): charge this batch's
+                # marshalled-bytes estimate BEFORE building the prebake;
+                # blocks (a visible resource.throttle, not a crash)
+                # while other batches hold the budget, released when
+                # this batch's polish completes
+                if self.budget is not None:
+                    from pbccs_tpu.parallel.batch import premarshal_nbytes
+
+                    lease = self.budget.admit(
+                        premarshal_nbytes((imax, jmax, r, z)),
+                        site="sched.prepare", abort=stop.is_set)
+                    if stop.is_set():
+                        if lease is not None:
+                            lease.release()
+                        return
                 # pre-bake the polish marshalling HERE, on the prepare
                 # worker: padded numpy planes + f64 SNR tables build while
                 # the device threads polish earlier batches, so
@@ -148,11 +179,19 @@ class ScheduledPipeline:
                             raise_device_shaped=fleet and attempts[0] == 1,
                             prebaked=prebaked)
 
+                from pbccs_tpu.resilience import resources
+
                 self.pool.submit(
                     key, polish, zmws=len(preps),
+                    capacity_bucket=resources.shape_bucket(imax, jmax, r),
                     callback=lambda fut: polish_done(seq, idx, tally,
-                                                     preps, fut))
+                                                     preps, fut, lease))
             except BaseException as e:  # noqa: BLE001 -- surfaced in run()
+                # the callback never ran (pool closed, prebake blew up):
+                # the budget charge must not outlive the batch (release
+                # is idempotent, so a raced callback is harmless)
+                if lease is not None:
+                    lease.release()
                 finish(seq, e)
 
         prep_pool = ThreadPoolExecutor(
@@ -198,7 +237,11 @@ class ScheduledPipeline:
         finally:
             # a consumer that bailed mid-stream (journal write failed,
             # generator closed) leaves the feeder parked in sem.acquire;
-            # wake it so the thread (and the input reader it holds) ends
+            # wake it so the thread (and the input reader it holds) ends.
+            # A prep worker parked in budget.admit() observes the abort
+            # flag (admit polls it), so shutdown never hangs on the
+            # budget; in-flight batches release their leases from the
+            # polish_done callback when the pool settles their futures.
             stop.set()
             sem.release()
             feeder_done.wait(timeout=10.0)

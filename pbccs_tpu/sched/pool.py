@@ -177,6 +177,13 @@ class _Task:
     # THEIR device or fail loudly -- a silent requeue elsewhere would let
     # a warmup "succeed" while leaving the pinned device cold
     pinned: bool = False
+    # the resources.shape_bucket this task polishes in: a capacity-shaped
+    # (OOM) failure records a governor ceiling under it and requeues the
+    # task to the SAME device, where the pipeline's admission pre-split
+    # dispatches it in ceiling-sized parts.  None = no capacity handling
+    # (the failure classifies task-shaped instead).
+    capacity_bucket: Hashable | None = None
+    capacity_requeues: int = 0
 
 
 class _Worker:
@@ -253,7 +260,8 @@ class DevicePool:
                zmws: int = 1,
                callback: Callable[[SchedFuture], None] | None = None,
                worker_index: int | None = None,
-               pin: bool = False) -> SchedFuture:
+               pin: bool = False,
+               capacity_bucket: Hashable | None = None) -> SchedFuture:
         """Queue fn(device) on a device chosen by the routing policy.
 
         `key` is the sticky-routing bucket (callers pass the compiled
@@ -265,7 +273,14 @@ class DevicePool:
         device cold while reporting success).  Without `pin`, placement
         is initial-only and failures requeue normally.  The future
         completes with fn's result, or -- after device-level requeues
-        are exhausted -- its last exception."""
+        are exhausted -- its last exception.
+
+        `capacity_bucket` (a resources.shape_bucket) opts the task into
+        OOM-adaptive handling: a capacity-shaped failure records a
+        MemoryGovernor ceiling for (device, bucket) and requeues to the
+        SAME device -- no strike, no bench, no fleet tour -- where the
+        pipeline's admission pre-split re-dispatches it in ceiling-sized
+        parts (see resilience.resources)."""
         if pin and worker_index is None:
             raise ValueError("pin=True requires worker_index")
         if worker_index is not None and not (
@@ -276,7 +291,8 @@ class DevicePool:
             raise ValueError(
                 f"worker_index {worker_index} out of range "
                 f"[0, {len(self._workers)})")
-        task = _Task(key, fn, zmws, SchedFuture(callback), pinned=pin)
+        task = _Task(key, fn, zmws, SchedFuture(callback), pinned=pin,
+                     capacity_bucket=capacity_bucket)
         with self._cv:
             if self._closed:
                 raise PoolClosed("device pool is closed")
@@ -343,15 +359,20 @@ class DevicePool:
     def _run_task(self, w: _Worker, task: _Task) -> None:
         import jax
 
-        from pbccs_tpu.resilience import faults
+        from pbccs_tpu.resilience import faults, resources
 
         try:
             # the device-level chaos site: keyed by WORKER name so a spec
             # can sicken one device (ZMW-poison specs live inside the
-            # dispatch fn, at pipeline's polish.dispatch site)
-            faults.maybe_fail("sched.dispatch", keys=[w.name, str(task.key)])
-            with jax.default_device(w.device):
-                result = task.fn(w.device)
+            # dispatch fn, at pipeline's polish.dispatch site); oom-kind
+            # specs here model the device rejecting the batch shape.
+            # device_scope tags the thread so the pipeline's governor
+            # lookups/records key ceilings per THIS device.
+            with resources.device_scope(w.name):
+                faults.maybe_fail("sched.dispatch",
+                                  keys=[w.name, str(task.key)])
+                with jax.default_device(w.device):
+                    result = task.fn(w.device)
         except BaseException as e:  # noqa: BLE001 -- classified below
             self._on_task_error(w, task, e)
             return
@@ -363,9 +384,41 @@ class DevicePool:
 
     def _on_task_error(self, w: _Worker, task: _Task,
                        exc: BaseException) -> None:
-        from pbccs_tpu.resilience import faults, retry, watchdog
+        from pbccs_tpu.resilience import faults, resources, retry, watchdog
 
         w.m_failures.inc()
+        # CAPACITY-shaped failures (device OOM / RESOURCE_EXHAUSTED) are
+        # classified FIRST: the batch SHAPE overflows the device, which
+        # is neither sick hardware (striking/benching a healthy device
+        # would shrink the fleet for a workload problem) nor a poison
+        # input (quarantine would tour healthy ZMWs).  Record the shape
+        # ceiling and requeue to the SAME device: the pipeline's
+        # admission pre-split (polish_prepared_batch) dispatches the
+        # requeued batch in ceiling-sized parts there.
+        if (task.capacity_bucket is not None and not task.pinned
+                and resources.is_capacity_error(exc)
+                # halvings are bounded: each requeue lowers the ceiling,
+                # so a closure that somehow ignores the governor still
+                # terminates in O(log Z) requeues and surfaces
+                and task.capacity_requeues <= max(1, task.zmws).bit_length()):
+            resources.default_governor().record_oom(
+                task.capacity_bucket, max(1, task.zmws), device=w.name)
+            resources.note_oom_split()
+            self._log.warn(
+                f"sched: capacity failure on {w.name} (bucket "
+                f"{task.key!r}, {task.zmws} ZMW(s)): "
+                f"{type(exc).__name__}: {exc}; requeueing for a "
+                "governor-split re-dispatch on the same device")
+            with self._cv:
+                task.capacity_requeues += 1
+                if not self._closed and not w.benched:
+                    _m_requeues.inc()
+                    self._enqueue_locked(w, task)
+                    self._cv.notify_all()
+                    return
+            # pool closed (or the device benched) under us: surface
+            task.future._finish(exc=exc)
+            return
         # device-shaped = the failure modes that indicate SICK HARDWARE,
         # not a bad input: a hang (WatchdogTimeout), an XLA runtime error
         # (transient ones were already retried inside the dispatch by

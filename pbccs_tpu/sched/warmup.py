@@ -130,18 +130,53 @@ def run_warmup(argv: list[str] | None = None) -> int:
     targets = devices if args.allDevices else devices[:1]
     entries = [parse_bucket(b) for b in args.bucket]
 
+    from pbccs_tpu.parallel.batch import effective_shapes
+    from pbccs_tpu.resilience import resources
+
+    gov = resources.default_governor()
     report = []
     for (z, passes, length) in entries:
         tasks = _synth_tasks(z, passes, length)
+        imax, jmax, r, _ = effective_shapes(
+            len(tasks), max(len(t.reads) for t in tasks),
+            max(len(rd) for t in tasks for rd in t.reads),
+            max(len(t.tpl) for t in tasks))
+        bucket = resources.shape_bucket(imax, jmax, r)
         for dev in targets:
             name = f"{dev.platform}:{dev.id}"
+            # the warmup menu consults the same ceilings production
+            # dispatch learns: warming a Z the device cannot hold would
+            # compile (and OOM) a shape no batch will ever run at
+            cap = gov.cap(bucket, device=name)
+            sub = tasks if cap is None else tasks[:cap]
+            if len(sub) < len(tasks):
+                log.warn(f"warmup: bucket {z}x{passes}x{length} clamped "
+                         f"to Z={len(sub)} by the memory governor "
+                         f"ceiling on {name}")
             log.info(f"warmup: bucket {z}x{passes}x{length} on {name}")
             t0 = time.monotonic()
-            with jax.default_device(dev):
-                shapes = _warm_one(tasks)
+            shapes = None
+            while True:
+                try:
+                    with resources.device_scope(name), \
+                            jax.default_device(dev):
+                        shapes = _warm_one(sub)
+                    break
+                except Exception as e:  # noqa: BLE001 -- classified below
+                    if not resources.is_capacity_error(e) or len(sub) == 1:
+                        raise
+                    # warmup discovers the ceiling BEFORE traffic does:
+                    # record it and warm the largest Z that fits
+                    ceiling = gov.record_oom(bucket, len(sub), device=name)
+                    log.warn(f"warmup: {z}x{passes}x{length} OOMed at "
+                             f"Z={len(sub)} on {name}; retrying at "
+                             f"Z={ceiling}")
+                    sub = sub[:ceiling]
             dt = time.monotonic() - t0
             entry = {"bucket": f"{z}x{passes}x{length}", "device": name,
                      "seconds": round(dt, 2), "shapes": shapes}
+            if len(sub) < len(tasks):
+                entry["governor_clamped_z"] = len(sub)
             report.append(entry)
             log.info(f"warmup: {entry['bucket']} on {name}: "
                      f"{dt:.1f}s, shapes {shapes}")

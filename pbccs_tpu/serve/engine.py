@@ -74,6 +74,21 @@ _m_latency = _reg.histogram("ccs_serve_request_latency_seconds",
                             buckets=log_buckets(1e-3, 300.0))
 
 
+def _flush_shapes(preps: Sequence[PreparedZmw]) -> tuple[int, int, int]:
+    """The (imax, jmax, r) bucket a flush of these preps polishes in --
+    the ONE derivation shared by the pinned polish call and the
+    capacity-bucket key, so the governor ceiling the pool records is
+    the same key the polish-time admission pre-split looks up."""
+    from pbccs_tpu.parallel.batch import length_bucket
+    from pbccs_tpu.utils import next_pow2
+
+    jmax, imax = length_bucket(
+        max(len(p.css) for p in preps),
+        max((len(m.seq) for p in preps for m in p.mapped), default=8))
+    r = next_pow2(max(len(p.mapped) for p in preps), 4)
+    return imax, jmax, r
+
+
 def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings, *,
                          raise_device_shaped: bool = False):
     """polish_prepared_batch with shapes pinned to the flush's length
@@ -82,13 +97,9 @@ def _polish_shape_pinned(preps: Sequence[PreparedZmw], settings, *,
     would mint a fresh compiled device loop per (Z, R) combination -- the
     same bounded-program-menu rule the offline straggler/wide-retry
     sub-batches follow (parallel/batch.py BatchPolisher `buckets`)."""
-    from pbccs_tpu.parallel.batch import length_bucket
     from pbccs_tpu.utils import next_pow2
 
-    jmax, imax = length_bucket(
-        max(len(p.css) for p in preps),
-        max((len(m.seq) for p in preps for m in p.mapped), default=8))
-    r = next_pow2(max(len(p.mapped) for p in preps), 4)
+    imax, jmax, r = _flush_shapes(preps)
     return polish_prepared_batch(preps, settings,
                                  buckets=(imax, jmax, r),
                                  min_z=next_pow2(len(preps), 4),
@@ -481,7 +492,43 @@ class CcsEngine:
             for batch in batches:
                 self._dispatch(batch)
 
+    def _capacity_bucket(self, batch: Batch):
+        """The resources.shape_bucket this flush polishes in (the shape
+        derivation is _flush_shapes, shared with _polish_shape_pinned),
+        so governor ceilings learned at dispatch time pre-split later
+        flushes."""
+        from pbccs_tpu.resilience import resources
+
+        preps = [item.payload[1] for item in batch.items]
+        return resources.shape_bucket(*_flush_shapes(preps))
+
     def _dispatch(self, batch: Batch) -> None:
+        from pbccs_tpu.resilience import resources
+
+        # serve flushes consult the governor's learned ceilings: a
+        # bucket that OOMed at some Z dispatches as ceiling-sized
+        # sub-batches from the start (fleet-wide minimum -- the target
+        # device is not picked yet), instead of paying the OOM again
+        bucket = self._capacity_bucket(batch)
+        cap = resources.default_governor().cap(bucket)
+        parts = [batch]
+        if cap is not None and len(batch.items) > cap:
+            resources.note_presplit()
+            self._log.info(
+                f"flush bucket={batch.key}: governor ceiling {cap} "
+                f"splits {len(batch.items)} ZMW(s) into "
+                f"{len(resources.split_sizes(len(batch.items), cap))} "
+                "dispatches")
+            parts, start = [], 0
+            for size in resources.split_sizes(len(batch.items), cap):
+                parts.append(Batch(batch.key,
+                                   batch.items[start:start + size],
+                                   batch.reason))
+                start += size
+        for part in parts:
+            self._dispatch_part(part, bucket)
+
+    def _dispatch_part(self, batch: Batch, capacity_bucket) -> None:
         with self._lock:
             self._in_flight_batches += 1
             self._in_flight_zmws += len(batch.items)
@@ -494,7 +541,9 @@ class CcsEngine:
             # device-fleet mode: the pool picks the device (sticky by the
             # batch's compiled-shape bucket); a device-shaped failure
             # requeues the WHOLE batch to a healthy device before the
-            # requests see an error (pbccs_tpu/sched)
+            # requests see an error (pbccs_tpu/sched), and a
+            # capacity-shaped one records a governor ceiling + requeues
+            # to the same device for a split re-dispatch
             attempts = [0]
 
             def run(_device, batch=batch, attempts=attempts):
@@ -504,6 +553,7 @@ class CcsEngine:
 
             self._pool.submit(
                 batch.key, run, zmws=len(batch.items),
+                capacity_bucket=capacity_bucket,
                 callback=lambda fut: self._pool_done(batch, fut))
         else:
             self._polish_queue.put(batch)

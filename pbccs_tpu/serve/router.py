@@ -12,7 +12,14 @@ bench-and-requeue, bounded failure tours) to replica granularity:
     shared ``sched.health.StickyMap`` -- the replica that already
     compiled a bucket's program menu keeps receiving it, spilling to the
     least-loaded healthy replica only past ``spill_depth`` in-flight
-    (work-conserving stickiness, exactly the DevicePool rule).
+    (work-conserving stickiness, exactly the DevicePool rule).  Load is
+    weighted by the replica's STATUS-REPORTED queue depth, not the
+    router's own in-flight count alone: each health probe's `status`
+    reply carries the engine's `pending` figure, and the excess over
+    what this router has in flight (work admitted from other clients,
+    or a backlog the engine is still chewing) counts toward the
+    replica's effective depth -- an unevenly-loaded fleet spills away
+    from the busy replica instead of queueing blindly behind it.
   * **Health checks.**  A background loop probes every replica with the
     protocol's `status` verb; a probe unanswered past
     ``health_timeout_s`` is a strike, ``bench_after`` strikes mark the
@@ -224,6 +231,11 @@ class _Replica:
         self.link: ReplicaLink | None = None
         self.connecting = False     # a reconnect attempt is in flight
         self.draining = False       # replica said it stopped accepting
+        # engine-reported pending work BEYOND this router's own
+        # in-flight (other clients / engine backlog), refreshed by each
+        # status probe: routing weighs it so an unevenly-loaded fleet
+        # spills off the busy replica (0 until the first probe answers)
+        self.external_backlog = 0
         self.inflight: dict[str, RoutedRequest] = {}
         self.probe_id: str | None = None
         self.probe_t = 0.0
@@ -253,6 +265,12 @@ class _Replica:
 
     def depth(self) -> int:
         return len(self.inflight)
+
+    def effective_depth(self) -> int:
+        """Routing load: the router's own in-flight plus the engine's
+        status-reported backlog from elsewhere (ROADMAP item 5: weight
+        admission by replica status depth, not in-flight count alone)."""
+        return len(self.inflight) + self.external_backlog
 
 
 class CcsRouter:
@@ -433,11 +451,12 @@ class CcsRouter:
             return None
 
         def load(r: _Replica):
-            return (r.depth(), self._sticky.resident_count(r.name), r.index)
+            return (r.effective_depth(),
+                    self._sticky.resident_count(r.name), r.index)
 
         target, _outcome = self._sticky.route(
             req.key, eligible, member_id=lambda r: r.name, load=load,
-            depth=lambda r: r.depth(),
+            depth=lambda r: r.effective_depth(),
             spill_depth=self.config.spill_depth)
         return target
 
@@ -656,6 +675,7 @@ class CcsRouter:
                 replica.link = None
             moved = self._sweep_inflight_locked(replica)
             replica.probe_id = None
+            replica.external_backlog = 0   # stale once the link is gone
             benched = self._health.record_failure(replica.name)
             if benched:
                 replica.m_unhealthy.inc()
@@ -688,9 +708,11 @@ class CcsRouter:
                 replica.link = link
                 # a fresh connection says nothing about engine health; a
                 # reconnect after drain must also clear the drain flag so
-                # the next probe can re-admit a restarted replica
+                # the next probe can re-admit a restarted replica (and a
+                # restarted replica's backlog figure starts clean)
                 replica.draining = False
                 replica.probe_id = None
+                replica.external_backlog = 0
         if stale:
             link.close()
             return
@@ -762,6 +784,10 @@ class CcsRouter:
 
     def _on_probe_reply(self, replica: _Replica, msg: dict) -> None:
         accepting = bool(msg.get("accepting", True))
+        try:
+            pending = max(0, int(msg.get("pending", 0)))
+        except (TypeError, ValueError):
+            pending = 0
         with self._lock:
             if msg.get("id") != replica.probe_id:
                 # a STALE probe reply (its timeout already struck, or it
@@ -773,6 +799,10 @@ class CcsRouter:
                 return
             replica.probe_id = None
             replica.draining = not accepting
+            # admission weighting: the engine's pending figure minus
+            # what WE have in flight there is load other clients (or an
+            # engine backlog) put on it; fold it into routing depth
+            replica.external_backlog = max(0, pending - replica.depth())
             recovered = self._health.record_success(replica.name)
         replica.m_hc_ok.inc()
         if recovered:
@@ -789,6 +819,7 @@ class CcsRouter:
                 "healthy": self._health.healthy(r.name),
                 "draining": r.draining,
                 "inflight": r.depth(),
+                "external_backlog": r.external_backlog,
                 "routed": r.routed,
                 "failovers": r.failovers,
             } for r in self._replicas]

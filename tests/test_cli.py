@@ -119,3 +119,14 @@ def test_cli_missing_input(tmp_path):
     rc = run([str(tmp_path / "o.bam"), str(tmp_path / "missing.bam"),
               "--skipChemistryCheck"])
     assert rc == 2
+
+
+@pytest.mark.parametrize("bad", ["eight gigs", "0", "0.5"])
+def test_cli_rejects_bad_mem_budget(tmp_path, bad):
+    """Unparseable AND sub-byte budgets are usage errors before any
+    input is read (HostBudget would otherwise reject '0' mid-run as an
+    uncaught ValueError)."""
+    fasta = str(tmp_path / "x.fasta")
+    write_fasta(fasta, [("m/1/0_4", "ACGT")])
+    rc = run([str(tmp_path / "o.bam"), fasta, "--memBudget", bad])
+    assert rc == 2

@@ -27,8 +27,12 @@ from pbccs_tpu.pipeline import (
     PreparedZmw,
     Subread,
 )
-from pbccs_tpu.resilience import checkpoint, faults, quarantine, retry, watchdog
+from pbccs_tpu.resilience import (checkpoint, faults, quarantine, resources,
+                                  retry, watchdog)
 from pbccs_tpu.resilience.faults import FaultSpecError, InjectedFault
+from pbccs_tpu.resilience.resources import (HostBudget, MemoryGovernor,
+                                            OutputWriteError, parse_size,
+                                            shape_bucket, split_sizes)
 
 # ----------------------------------------------------------------- helpers
 
@@ -200,8 +204,15 @@ class TestRetry:
         assert slept == []  # first 5 s backoff already busts the deadline
 
     def test_transient_classifier(self):
-        assert retry.is_transient_device_error(
+        # RESOURCE_EXHAUSTED is CAPACITY-shaped, never transient: a
+        # same-shape retry of an OOM cannot succeed, so the adaptive
+        # split path owns it (resilience.resources)
+        assert not retry.is_transient_device_error(
             RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert resources.is_capacity_error(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert retry.is_transient_device_error(
+            RuntimeError("UNAVAILABLE: device preempted"))
         assert retry.is_transient_device_error(
             InjectedFault("polish.dispatch", "transient"))
         assert not retry.is_transient_device_error(
@@ -449,6 +460,584 @@ class TestCheckpoint:
         restored = checkpoint.CheckpointJournal(path).load(fp)
         assert sorted(restored) == [0, 1]
         assert [r.id for r in restored[0].results] == ["m/0x"]
+
+
+# ---------------------------------------- resource-exhaustion governance
+
+
+class TestCapacityClassification:
+    def test_capacity_markers(self):
+        assert resources.is_capacity_error(
+            RuntimeError("RESOURCE_EXHAUSTED: Attempting to allocate"))
+        assert resources.is_capacity_error(MemoryError())
+        assert resources.is_capacity_error(
+            RuntimeError("Out of memory allocating 2.1G in HBM"))
+        assert resources.is_capacity_error(
+            InjectedFault("sched.dispatch", "RESOURCE_EXHAUSTED"))
+        assert not resources.is_capacity_error(ValueError("bad template"))
+        assert not resources.is_capacity_error(
+            RuntimeError("UNAVAILABLE: preempted"))
+
+    def test_oom_fault_kind_is_capacity_not_transient(self):
+        with faults.active("sched.dispatch:oom@1"):
+            with pytest.raises(InjectedFault) as ei:
+                faults.maybe_fail("sched.dispatch", keys=["cpu:0"])
+        assert resources.is_capacity_error(ei.value)
+        assert not retry.is_transient_device_error(ei.value)
+
+    def test_enospc_fault_kind_raises_real_oserror(self):
+        with faults.active("checkpoint.record:enospc@1"):
+            with pytest.raises(OSError) as ei:
+                faults.maybe_fail("checkpoint.record", keys=["chunk"])
+        import errno
+
+        assert ei.value.errno == errno.ENOSPC
+
+    def test_grammar_accepts_new_kinds(self):
+        specs = faults.parse_faults(
+            "sched.dispatch:oom@1*1,output.write:enospc~bam@2")
+        assert [s.kind for s in specs] == ["oom", "enospc"]
+        with pytest.raises(FaultSpecError):
+            faults.parse_faults("site:eNoSpC")
+
+
+class TestMemoryGovernor:
+    def test_ceiling_learn_and_apply(self):
+        gov = MemoryGovernor()
+        b = shape_bucket(128, 256, 8)
+        assert gov.cap(b) is None
+        assert gov.record_oom(b, 64, device="tpu:0") == 32
+        assert gov.cap(b, device="tpu:0") == 32
+        # a device with no own record inherits the fleet minimum
+        # (pessimistic warm start, no per-device re-discovery)
+        assert gov.cap(b, device="tpu:1") == 32
+        assert gov.cap(b) == 32
+        # ceilings only ever lower: a later SMALLER OOM tightens, a
+        # later larger one cannot loosen
+        assert gov.record_oom(b, 16, device="tpu:0") == 8
+        assert gov.record_oom(b, 100, device="tpu:0") == 8
+        assert gov.cap(b, device="tpu:0") == 8
+        # an unrelated bucket is unaffected
+        assert gov.cap(shape_bucket(64, 128, 4)) is None
+
+    def test_ceiling_reset_on_device_readmit(self):
+        gov = MemoryGovernor()
+        b = shape_bucket(128, 256, 8)
+        gov.record_oom(b, 64, device="tpu:0")
+        gov.record_oom(b, 32, device="tpu:1")
+        assert gov.reset_device("tpu:0") == 1
+        # the re-admitted device re-learns; until then it inherits the
+        # surviving fleet minimum
+        assert gov.cap(b, device="tpu:0") == 16
+        assert gov.reset_device("tpu:1") == 1
+        assert gov.cap(b) is None
+        assert gov.reset_device("tpu:1") == 0
+
+    def test_split_sizes_greedy_minimizes_pow2_padding(self):
+        # cap-sized parts are pow2 (a ceiling is Z//2 of a pow2
+        # dispatch) and pad nothing; only the remainder is ragged
+        assert split_sizes(10, 4) == [4, 4, 2]
+        assert split_sizes(4, 4) == [4]
+        assert split_sizes(5, 4) == [4, 1]
+        assert split_sizes(12, 8) == [8, 4]
+        assert split_sizes(1, 3) == [1]
+        assert sum(split_sizes(1023, 64)) == 1023
+        assert max(split_sizes(1023, 64)) == 64
+        with pytest.raises(ValueError):
+            split_sizes(4, 0)
+
+    def test_device_scope_thread_local(self):
+        assert resources.current_device() == "host"
+        with resources.device_scope("tpu:3"):
+            assert resources.current_device() == "tpu:3"
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(resources.current_device()))
+            t.start()
+            t.join()
+            assert seen == ["host"]   # scope never leaks across threads
+        assert resources.current_device() == "host"
+
+
+class TestHostBudget:
+    def test_parse_size(self):
+        assert parse_size("8G") == 8 << 30
+        assert parse_size("512M") == 512 << 20
+        assert parse_size("1.5K") == 1536
+        assert parse_size("12345") == 12345
+        assert parse_size("2GiB") == 2 << 30
+        with pytest.raises(ValueError):
+            parse_size("eight gigs")
+
+    def test_gate_blocks_until_release(self):
+        b = HostBudget(100)
+        first = b.admit(80, site="t")
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(b.admit(50, site="t")))
+        t.start()
+        time.sleep(0.15)
+        assert not got                      # parked: 80 + 50 > 100
+        first.release()
+        t.join(timeout=5.0)
+        assert got and got[0] is not None
+        assert b.in_use() == 50
+        assert b.throttle_count() == 1
+        got[0].release()
+        assert b.in_use() == 0
+
+    def test_oversize_charge_admits_alone(self):
+        b = HostBudget(10)
+        lease = b.admit(500, site="t")
+        assert lease is not None and b.in_use() == 500
+        lease.release()
+
+    def test_abort_unblocks_waiter(self):
+        b = HostBudget(10)
+        hold = b.admit(10, site="t")
+        flag = threading.Event()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(
+                b.admit(5, site="t", abort=flag.is_set)))
+        t.start()
+        time.sleep(0.1)
+        flag.set()
+        t.join(timeout=5.0)
+        assert got == [None]                # aborted, nothing charged
+        assert b.in_use() == 10
+        hold.release()
+
+    def test_release_idempotent(self):
+        b = HostBudget(100)
+        lease = b.admit(60, site="t")
+        lease.release()
+        lease.release()
+        assert b.in_use() == 0
+
+    def test_rejects_nonpositive_budget(self):
+        with pytest.raises(ValueError):
+            HostBudget(0)
+
+
+class TestDiskFullWriters:
+    def _records(self):
+        from pbccs_tpu.io.bam import BamRecord
+
+        return [BamRecord(name=f"m/{i}/ccs", seq="ACGTACGT",
+                          qual="IIIIIIII", tags={"zm": i})
+                for i in range(3)]
+
+    def _write_all(self, path):
+        from pbccs_tpu.io.bam import BamHeader, BamWriter, ReadGroupInfo
+
+        header = BamHeader(read_groups=[ReadGroupInfo("m", "CCS")])
+        with BamWriter(str(path), header) as bw:
+            for rec in self._records():
+                bw.write(rec)
+
+    def test_bam_enospc_structured_and_rewrite_identical(self, tmp_path):
+        control = tmp_path / "control.bam"
+        self._write_all(control)
+        out = tmp_path / "out.bam"
+        scope = default_registry().scope()
+        # header write is eligible call 1; fail on the 3rd write
+        with faults.active("output.write:enospc@3*1"):
+            with pytest.raises(OutputWriteError) as ei:
+                self._write_all(out)
+        assert ei.value.sink == "bam"
+        import errno
+
+        assert ei.value.errno == errno.ENOSPC
+        # atomic: neither a torn output nor a leftover temp is published
+        assert not out.exists()
+        assert not (tmp_path / "out.bam.tmp").exists()
+        assert scope.counter_value("ccs_output_write_errors_total",
+                                   sink="bam") == 1
+        # disk "freed": the rewrite is byte-identical to the control
+        self._write_all(out)
+        assert out.read_bytes() == control.read_bytes()
+
+    def test_bam_body_exception_discards_tmp(self, tmp_path):
+        from pbccs_tpu.io.bam import BamHeader, BamWriter, ReadGroupInfo
+
+        out = tmp_path / "out.bam"
+        with pytest.raises(RuntimeError, match="boom"):
+            with BamWriter(str(out),
+                           BamHeader(read_groups=[
+                               ReadGroupInfo("m", "CCS")])) as bw:
+                bw.write(self._records()[0])
+                raise RuntimeError("boom")
+        assert not out.exists()
+        assert not (tmp_path / "out.bam.tmp").exists()
+
+    def test_report_enospc_atomic(self, tmp_path):
+        from pbccs_tpu.io.report import write_report_file
+        from pbccs_tpu.pipeline import ResultTally
+
+        tally = ResultTally()
+        tally.tally(Failure.SUCCESS)
+        path = tmp_path / "report.csv"
+        with faults.active("output.write:enospc~report@1*1"):
+            with pytest.raises(OutputWriteError) as ei:
+                write_report_file(str(path), tally)
+        assert ei.value.sink == "report"
+        assert not path.exists()
+        assert not (tmp_path / "report.csv.tmp").exists()
+        write_report_file(str(path), tally)
+        assert "Success -- CCS generated,1" in path.read_text()
+
+
+class TestCheckpointDiskFull:
+    def _tallies(self):
+        from pbccs_tpu.pipeline import ResultTally
+
+        out = []
+        for i in range(3):
+            t = ResultTally()
+            t.tally(Failure.SUCCESS)
+            t.results.append(fake_result(f"m/{i}"))
+            out.append(t)
+        return out
+
+    def _restore_map(self, path, fp):
+        restored = checkpoint.CheckpointJournal(str(path)).load(fp)
+        return {i: [r.id for r in t.results] for i, t in restored.items()}
+
+    def test_enospc_mid_record_then_resume_byte_identity(self, tmp_path):
+        fp = {"v": 1}
+        tallies = self._tallies()
+        control = tmp_path / "control.ndjson"
+        j = checkpoint.CheckpointJournal(str(control))
+        j.start(fp, resume=False)
+        for i, t in enumerate(tallies):
+            j.record_chunk(i, t)
+        j.close()
+        want = self._restore_map(control, fp)
+
+        path = tmp_path / "run.ndjson"
+        j = checkpoint.CheckpointJournal(str(path))
+        j.start(fp, resume=False)
+        j.record_chunk(0, tallies[0])
+        # disk fills while appending chunk 1: structured error with
+        # bytes-written accounting, journal keeps its complete prefix
+        with faults.active("checkpoint.record:enospc@1*1"):
+            with pytest.raises(OutputWriteError) as ei:
+                j.record_chunk(1, tallies[1])
+        assert ei.value.sink == "checkpoint"
+        # bytes-written accounting: exactly the durable prefix on disk
+        assert ei.value.bytes_written == path.stat().st_size
+        # emulate the short write a real ENOSPC leaves: a torn partial
+        # line at the tail (no newline)
+        with open(path, "ab") as fh:
+            fh.write(b'{"type":"chunk","index":1,"cou')
+
+        # space freed -> resume: the torn tail is dropped AND trimmed,
+        # the rerun journals the missing chunks, and the final restore
+        # set equals the uninterrupted run's
+        j2 = checkpoint.CheckpointJournal(str(path))
+        restored = j2.load(fp)
+        assert sorted(restored) == [0]
+        j2.start(fp, resume=True)
+        for i in (1, 2):
+            j2.record_chunk(i, tallies[i])
+        j2.close()
+        assert self._restore_map(path, fp) == want
+        # every journal line parses (the torn tail did not concatenate
+        # into the resumed records)
+        for line in path.read_bytes().splitlines():
+            json.loads(line)
+
+    def test_close_reraise_does_not_clobber_structured_error(
+            self, tmp_path):
+        """A REAL full disk raises from flush() with bytes parked in
+        the BufferedWriter; the teardown close() re-flushes and raises
+        the same ENOSPC -- which must not replace the structured
+        OutputWriteError with a raw OSError traceback."""
+        import errno
+
+        class FullDiskFile:
+            def __init__(self, fh):
+                self._fh = fh
+
+            def write(self, data):       # buffers fine, like a real fd
+                return len(data)
+
+            def tell(self):
+                return 0
+
+            def flush(self):
+                raise OSError(errno.ENOSPC, "No space left on device")
+
+            def close(self):             # close re-flushes -> re-raises
+                raise OSError(errno.ENOSPC, "No space left on device")
+
+        path = tmp_path / "full.ndjson"
+        j = checkpoint.CheckpointJournal(str(path))
+        j.start({"v": 1}, resume=False)
+        real_fh = j._fh
+        j._fh = FullDiskFile(real_fh)
+        try:
+            with pytest.raises(OutputWriteError) as ei:
+                j.record_chunk(0, self._tallies()[0])
+        finally:
+            real_fh.close()
+        assert ei.value.sink == "checkpoint"
+        assert j._fh is None             # handle dropped, journal kept
+
+    def test_trim_noop_on_clean_journal(self, tmp_path):
+        fp = {"v": 1}
+        path = tmp_path / "clean.ndjson"
+        j = checkpoint.CheckpointJournal(str(path))
+        j.start(fp, resume=False)
+        j.record_chunk(0, self._tallies()[0])
+        j.close()
+        before = path.read_bytes()
+        j2 = checkpoint.CheckpointJournal(str(path))
+        j2.load(fp)
+        j2.start(fp, resume=True)
+        j2.close()
+        assert path.read_bytes() == before
+
+
+class TestOomAdaptiveDispatch:
+    """polish_prepared_batch's capacity governance, with the device
+    dispatch stubbed: a RESOURCE_EXHAUSTED at batch size Z must split
+    (pinned shapes, outcomes aligned), record a governor ceiling, and
+    pre-split the NEXT batch for the bucket at admission -- never a
+    same-shape retry loop, never quarantine of healthy ZMWs."""
+
+    @pytest.fixture(autouse=True)
+    def fresh_governor(self, monkeypatch):
+        monkeypatch.setattr(resources, "_default_governor",
+                            MemoryGovernor())
+
+    def _preps(self, n):
+        return [make_prep(f"m/{i}") for i in range(n)]
+
+    def test_oom_splits_and_records_ceiling(self, monkeypatch):
+        from pbccs_tpu import pipeline
+
+        sizes = []
+
+        def stub_dispatch(preps, settings, *, buckets=None, min_z=1,
+                          prebaked=None):
+            sizes.append(len(preps))
+            if len(preps) > 2:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                                   "allocating scratch")
+            return [(Failure.SUCCESS, None) for _ in preps]
+
+        monkeypatch.setattr(pipeline, "_guarded_dispatch", stub_dispatch)
+        scope = default_registry().scope()
+        out = pipeline.polish_prepared_batch(self._preps(6))
+        assert len(out) == 6
+        assert all(f == Failure.SUCCESS for f, _ in out)
+        # 6 OOMs -> 3+3, each OOMs -> 1+2, 2+1 -- no same-shape retry
+        assert sizes[0] == 6 and max(sizes[1:]) <= 3
+        assert scope.counter_value("ccs_resource_oom_splits_total") >= 1
+        assert scope.counter_value("ccs_resource_oom_ceilings_total") >= 1
+        gov = resources.default_governor()
+        assert gov.snapshot()        # a ceiling was recorded
+        # the NEXT batch for this bucket pre-splits at admission: no
+        # dispatch bigger than the learned ceiling, no new OOM
+        sizes.clear()
+        out2 = pipeline.polish_prepared_batch(self._preps(6))
+        assert len(out2) == 6
+        assert max(sizes) <= 2
+        assert scope.counter_value(
+            "ccs_resource_presplit_batches_total") >= 1
+
+    def test_oom_singleton_serial_rescue_not_retry(self, monkeypatch):
+        from pbccs_tpu import pipeline
+
+        rescued = []
+
+        def stub_dispatch(preps, settings, **kw):
+            raise RuntimeError("RESOURCE_EXHAUSTED: always")
+
+        def stub_rescue(prep, settings, exc):
+            rescued.append(prep.chunk.id)
+            return (Failure.OTHER, None)
+
+        monkeypatch.setattr(pipeline, "_guarded_dispatch", stub_dispatch)
+        monkeypatch.setattr(quarantine, "serial_rescue", stub_rescue)
+        out = pipeline.polish_prepared_batch(self._preps(4))
+        assert len(out) == 4
+        assert all(f == Failure.OTHER for f, _ in out)
+        assert sorted(rescued) == [f"m/{i}" for i in range(4)]
+
+    def test_injected_oom_at_polish_dispatch_splits(self, monkeypatch):
+        """The fault grammar's oom kind at polish.dispatch drives the
+        same path as a real device OOM: one split, zero quarantined."""
+        from pbccs_tpu import pipeline
+
+        sizes = []
+
+        def spy(preps, settings, **kw):
+            sizes.append(len(preps))
+            return [(Failure.SUCCESS, None) for _ in preps]
+
+        monkeypatch.setattr(pipeline, "_polish_batch_arrow", spy)
+        scope = default_registry().scope()
+        with faults.active("polish.dispatch:oom@1*1"):
+            out = pipeline.polish_prepared_batch(self._preps(4))
+        assert len(out) == 4
+        assert all(f == Failure.SUCCESS for f, _ in out)
+        assert sizes == [2, 2]      # split halves, no same-shape retry
+        assert scope.counter_value("ccs_quarantined_zmws_total") == 0
+        assert scope.counter_value("ccs_resource_oom_splits_total") == 1
+        assert scope.counter_value(
+            "ccs_retries_total", site="polish.dispatch") == 0
+
+
+class TestPoolCapacityHandling:
+    @pytest.fixture(autouse=True)
+    def fresh_governor(self, monkeypatch):
+        monkeypatch.setattr(resources, "_default_governor",
+                            MemoryGovernor())
+
+    def test_capacity_failure_requeues_same_device_no_strike(self):
+        from pbccs_tpu.sched.pool import DevicePool
+
+        bucket = shape_bucket(64, 128, 4)
+        calls = []
+
+        def flaky(device):
+            calls.append(resources.current_device())
+            if len(calls) == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: HBM full")
+            return "ok"
+
+        with DevicePool() as pool:
+            fut = pool.submit("k", flaky, zmws=8, capacity_bucket=bucket)
+            assert fut.result(timeout=30.0) == "ok"
+            st = pool.status()
+        # requeued to the SAME device, which was neither struck nor
+        # benched (capacity != sick hardware)
+        assert len(set(calls)) == 1 and len(calls) == 2
+        assert st["devices"][0]["strikes"] == 0
+        assert not st["devices"][0]["benched"]
+        gov = resources.default_governor()
+        assert gov.cap(bucket, device=calls[0]) == 4
+
+    def test_injected_sched_oom_records_ceiling(self):
+        from pbccs_tpu.sched.pool import DevicePool
+
+        bucket = shape_bucket(64, 128, 4)
+        scope = default_registry().scope()
+        with faults.active("sched.dispatch:oom@1*1"):
+            with DevicePool() as pool:
+                fut = pool.submit("k", lambda device: "ok", zmws=6,
+                                  capacity_bucket=bucket)
+                assert fut.result(timeout=30.0) == "ok"
+                st = pool.status()
+        assert st["devices"][0]["strikes"] == 0
+        assert scope.counter_value("ccs_resource_oom_splits_total") == 1
+        assert scope.counter_value(
+            "ccs_sched_device_benched_total",
+            device=st["devices"][0]["device"]) == 0
+        assert resources.default_governor().cap(bucket) == 3
+
+    def test_capacity_without_bucket_stays_legacy(self):
+        from pbccs_tpu.sched.pool import DevicePool
+
+        def always_oom(device):
+            raise RuntimeError("RESOURCE_EXHAUSTED: HBM full")
+
+        with DevicePool() as pool:
+            fut = pool.submit("k", always_oom, zmws=4)
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                fut.result(timeout=30.0)
+        assert resources.default_governor().snapshot() == {}
+
+
+class TestBudgetedPipeline:
+    def test_tight_budget_never_deadlocks(self, monkeypatch):
+        """Regression: with prepare workers admitting out of sequence
+        order and a budget that fits ~one batch, a release tied to
+        ORDERED emission deadlocks (batch N+1's charge fills the budget
+        while batch N's prep blocks in admit).  Leases release at
+        polish completion, so the run must finish."""
+        from pbccs_tpu import pipeline
+        from pbccs_tpu.sched.executor import ScheduledPipeline
+        from pbccs_tpu.sched.pool import DevicePool
+
+        def stub_prepare(chunks, settings):
+            from pbccs_tpu.pipeline import ResultTally
+
+            time.sleep(0.01)
+            return ResultTally(), [make_prep(c.id) for c in chunks]
+
+        def stub_polish(preps, settings, **kw):
+            time.sleep(0.02)
+            return [(Failure.SUCCESS, fake_result(p.chunk.id))
+                    for p in preps]
+
+        monkeypatch.setattr(pipeline, "prepare_batch", stub_prepare)
+        monkeypatch.setattr(pipeline, "polish_prepared_batch",
+                            stub_polish)
+        monkeypatch.setattr(pipeline, "prebake_polish",
+                            lambda preps: None)
+        # budget fits ONE batch's estimate (the deadlock-shaped config)
+        from pbccs_tpu.parallel.batch import premarshal_nbytes
+
+        (imax, jmax, r), z = pipeline._pinned_batch_shapes(
+            [make_prep("m/0"), make_prep("m/1")], None, 1)
+        budget = HostBudget(premarshal_nbytes((imax, jmax, r, z)) + 1)
+        items = [(i, [make_chunk(f"m/{2 * i + k}") for k in range(2)],
+                  None) for i in range(8)]
+        with DevicePool() as pool:
+            pipe = ScheduledPipeline(pool, ConsensusSettings(),
+                                     prepare_workers=2, budget=budget)
+            got = {}
+            done = threading.Event()
+
+            def consume():
+                for idx, tally in pipe.run(iter(items)):
+                    got[idx] = tally
+                done.set()
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            assert done.wait(timeout=60.0), \
+                f"pipeline wedged with {len(got)}/8 batches emitted"
+            t.join(timeout=5.0)
+        assert sorted(got) == list(range(8))
+        assert all(t.counts[Failure.SUCCESS] == 2 for t in got.values())
+        assert budget.in_use() == 0   # every lease released
+
+
+class TestEngineGovernedFlush:
+    @pytest.fixture(autouse=True)
+    def fresh_governor(self, monkeypatch):
+        monkeypatch.setattr(resources, "_default_governor",
+                            MemoryGovernor())
+
+    def test_flush_pre_splits_at_learned_ceiling(self):
+        """A serve flush for a bucket with a learned ceiling dispatches
+        as ceiling-sized sub-batches (the fleet-wide conservative cap),
+        before any device is picked."""
+        from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+
+        sizes = []
+
+        def spy_polish(preps, settings):
+            sizes.append(len(preps))
+            return stub_polish(preps, settings)
+
+        # the stub prep geometry: css 64 bases, no mapped reads
+        bucket = shape_bucket(64, 128, 4)
+        resources.default_governor().record_oom(bucket, 8, device="tpu:9")
+        cfg = ServeConfig(max_batch=6, max_wait_ms=10.0)
+        with CcsEngine(config=cfg, prep_fn=stub_prep,
+                       polish_fn=spy_polish) as eng:
+            reqs = [eng.submit(make_chunk(f"m/{i}")) for i in range(6)]
+            for r in reqs:
+                assert r.wait(10.0)
+                assert r.failure == Failure.SUCCESS
+        assert sizes and max(sizes) <= 4
+        assert sum(sizes) == 6
 
 
 # ------------------------------------------- serve: retry + watchdog wiring

@@ -64,6 +64,9 @@ class FakeReplica:
         self.mode = mode
         self.answer_status = True
         self.accepting = True
+        # engine-reported backlog carried in status replies (the
+        # admission-weighting lever: work other clients put on us)
+        self.pending = 0
         self.received: list[str] = []
         self.held: list[tuple] = []
         self._lock = threading.Lock()
@@ -109,7 +112,8 @@ class FakeReplica:
                     if self.answer_status:
                         self._send(conn, {"type": "status",
                                           "id": msg.get("id"),
-                                          "accepting": self.accepting})
+                                          "accepting": self.accepting,
+                                          "pending": self.pending})
                 elif verb == "submit":
                     rid = msg.get("id")
                     with self._lock:
@@ -298,6 +302,54 @@ class TestRouting:
                     f.release()
                 for h in handles:
                     assert h.reply(10.0)["status"] == "Success"
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_admission_weights_reported_depth(self, fakes_pair):
+        """Uneven fleet: a replica whose status probe reports a deep
+        engine backlog (work OTHER clients put on it) stops winning
+        routes even though this router has nothing in flight there --
+        admission weighting by status depth, not in-flight count alone
+        (ROADMAP item 5 remainder)."""
+        a, b = fakes_pair
+        a.pending = 50
+        router, server = make_router(fakes_pair, spill_depth=2)
+        try:
+            # a probe cycle must observe the backlog before routing
+            assert wait_until(lambda: router.status()["replicas"][0]
+                              ["external_backlog"] == 50)
+            with CcsClient(server.host, server.port) as cli:
+                for i in range(4):
+                    msg = cli.submit_wire(dict(ZMW, id=f"m/{i}")).reply(10.0)
+                    assert msg["status"] == "Success"
+            assert not a.received
+            assert len(b.received) == 4
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_sticky_home_spills_on_reported_backlog(self, fakes_pair):
+        """The spill threshold counts the replica's reported backlog:
+        a sticky home that got busy from elsewhere loses its bucket's
+        overflow to the idle replica instead of queueing blindly."""
+        router, server = make_router(fakes_pair, spill_depth=2)
+        try:
+            with CcsClient(server.host, server.port) as cli:
+                assert cli.submit_wire(dict(ZMW, id="m/0")).reply(
+                    10.0)["status"] == "Success"
+                home = next(f for f in fakes_pair if f.received)
+                other = next(f for f in fakes_pair if f is not home)
+                home.pending = 50
+                idx = fakes_pair.index(home)
+                assert wait_until(lambda: router.status()["replicas"][idx]
+                                  ["external_backlog"] >= 49)
+                for i in range(1, 4):
+                    assert cli.submit_wire(dict(ZMW, id=f"m/{i}")).reply(
+                        10.0)["status"] == "Success"
+            # same bucket throughout; without depth weighting all four
+            # would stick to the home replica
+            assert other.received
         finally:
             server.shutdown()
             router.close()

@@ -21,7 +21,11 @@ skips:
      hung dispatch: the affected requests fail with a structured
      timeout, the engine keeps serving, and a follow-up request
      succeeds.
-  5. INPUT FUZZ -- the randomized long leg of tools/fuzz_inputs.py:
+  5. OOM MATRIX (--ooms) -- injected device OOMs at the dispatch site:
+     full output parity every round (never a quarantined healthy
+     batch), governor ceilings recorded, later rounds pre-split at
+     admission.
+  6. INPUT FUZZ -- the randomized long leg of tools/fuzz_inputs.py:
      --fuzzRounds seeded structured corruptions over the BAM decode
      classes (bit flips, truncation, length-field lies, tag mutations),
      asserting the hardening invariant at bench scale (process
@@ -67,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the kill -9 / crash CLI legs (fast mode)")
     p.add_argument("--fuzzRounds", type=int, default=40,
                    help="randomized input-fuzz rounds (0 disables)")
+    p.add_argument("--ooms", type=int, default=3,
+                   help="injected device-OOM rounds (governor split "
+                        "parity + admission pre-split; 0 disables)")
     p.add_argument("--out", default=None, help="also write the JSON here")
     return p
 
@@ -279,13 +286,49 @@ def leg_serve_watchdog(chunks, report: dict) -> None:
                   eng.status()["engine"] == "ccs-serve")
 
 
-# ---------------------------------------------------------- 5. input fuzz
+# ------------------------------------------------- 5. OOM-adaptive dispatch
+
+def leg_oom_matrix(chunks, args, report: dict) -> None:
+    """--ooms rounds of injected device OOMs at the dispatch site: every
+    round must complete with FULL output parity (a capacity failure
+    costs wall time, never results, and never quarantines a healthy
+    batch), the memory governor must record a shape ceiling, and later
+    rounds must pre-split at admission instead of re-discovering the
+    OOM."""
+    print(f"== leg 5: OOM-adaptive dispatch ({args.ooms} rounds) ==")
+    from pbccs_tpu.obs.metrics import default_registry
+    from pbccs_tpu.resilience import resources
+
+    base = process_chunks(list(chunks))
+    base_out = outputs(base)
+    reg = default_registry()
+    for rnd in range(args.ooms):
+        scope = reg.scope()
+        with faults.active("polish.dispatch:oom@1*1", seed=rnd):
+            oomed = process_chunks(list(chunks))
+        check(report, f"oom_round{rnd}_full_parity",
+              outputs(oomed) == base_out)
+        check(report, f"oom_round{rnd}_never_quarantines",
+              scope.counter_value("ccs_quarantined_zmws_total") == 0)
+        if rnd == 0:
+            check(report, "oom_split_redispatch",
+                  scope.counter_value(
+                      "ccs_resource_oom_splits_total") >= 1)
+        else:
+            check(report, f"oom_round{rnd}_admission_presplit",
+                  scope.counter_value(
+                      "ccs_resource_presplit_batches_total") >= 1)
+    check(report, "oom_governor_ceiling_recorded",
+          bool(resources.default_governor().snapshot()))
+
+
+# ---------------------------------------------------------- 6. input fuzz
 
 def leg_input_fuzz(args, report: dict) -> None:
     """The randomized long leg of the structured input fuzzer: every
     decode corruption class re-rolled --fuzzRounds times (fuzz_inputs
     --smoke is the deterministic tier-1 subset of this)."""
-    print(f"== leg 5: randomized input fuzz ({args.fuzzRounds} rounds) ==")
+    print(f"== leg 6: randomized input fuzz ({args.fuzzRounds} rounds) ==")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import fuzz_inputs
 
@@ -314,6 +357,8 @@ def main(argv=None) -> int:
             leg_kill9_resume(args, tmp, fasta, report)
             leg_crash_resume(args, tmp, fasta, report)
         leg_serve_watchdog(chunks, report)
+        if args.ooms:
+            leg_oom_matrix(chunks, args, report)
         if args.fuzzRounds:
             leg_input_fuzz(args, report)
     except CheckFailed as e:

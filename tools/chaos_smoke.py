@@ -125,6 +125,22 @@ def main() -> int:
           scope.counter_value("ccs_retries_total",
                               site="polish.dispatch") >= 1)
 
+    print("== device OOM -> governor split (never quarantine) ==")
+    scope = reg.scope()
+    with faults.active("polish.dispatch:oom@1*1"):
+        oomed = process_chunks(list(chunks))
+    check("all outputs identical after OOM split",
+          outputs(oomed) == base_out)
+    check("no ZMW quarantined by the OOM",
+          scope.counter_value("ccs_quarantined_zmws_total") == 0)
+    check("ccs_resource_oom_splits_total moved",
+          scope.counter_value("ccs_resource_oom_splits_total") >= 1)
+    check("governor recorded a shape ceiling",
+          scope.counter_value("ccs_resource_oom_ceilings_total") >= 1)
+    check("no same-shape retry of the OOM",
+          scope.counter_value("ccs_retries_total",
+                              site="polish.dispatch") == 0)
+
     print("== hung dispatch -> watchdog + bisection recovery ==")
     scope = reg.scope()
     # size the deadline as an operator would: well above a legitimate

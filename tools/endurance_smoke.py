@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Endurance smoke for the tier-1 gate: a scaled-down full SMRT cell
+streamed through the FLEET scheduler while every resource-exhaustion
+failure class the governance layer handles is injected, asserting zero
+lost ZMWs and output byte-identical to an unfaulted run.
+
+The spec-scale endurance run (ROADMAP item 4, ~150k ZMWs) meets exactly
+three failure classes a sustained run cannot avoid; this smoke scales
+the cell down (~2 min budget on CPU) but injects all three against real
+`ccs` subprocesses on a 2-virtual-device fleet:
+
+  oom       sched.dispatch:oom -- a device OOM mid-stream: the memory
+            governor must split the batch (never same-shape retry,
+            never quarantine a healthy batch) and the run completes
+  kill -9   SIGKILL after >= 2 journaled chunks: --resume restores the
+            journal prefix and recomputes only the rest
+  enospc    output.write:enospc~bam -- the disk fills while the BAM is
+            written: a structured failure (exit 1, no torn output
+            published, journal KEPT), then a final --resume once
+            "space is freed" finishes byte-identically
+
+The final BAM and CSV report must equal the unfaulted reference byte
+for byte, and the yield total must account every input ZMW.
+
+Usage:  JAX_PLATFORMS=cpu python tools/endurance_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")  # runnable as tools/endurance_smoke.py
+
+N_ZMWS = 24
+TPL_LEN = 60
+N_PASSES = 5
+CHUNK = 4          # -> 6 chunks: several journal records + dispatches
+DEVICES = 2
+SEED = 20260804
+
+_CHILD_ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    # the host refinement loop keeps the compile budget sane on CPU
+    # (parity-pinned against the device loop in test_device_refine)
+    PBCCS_DEVICE_REFINE="0",
+)
+_flags = _CHILD_ENV.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _CHILD_ENV["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={DEVICES}"
+    ).strip()
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}"
+          + (f"  ({detail})" if detail else ""))
+    if not ok:
+        raise SystemExit(f"endurance smoke failed: {name} {detail}")
+
+
+def write_workload(path: str) -> None:
+    import numpy as np
+
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(SEED)
+    with open(path, "w") as f:
+        for i in range(N_ZMWS):
+            _, reads, _, _snr = simulate_zmw(rng, TPL_LEN, N_PASSES)
+            for k, r in enumerate(reads):
+                f.write(f">cell/{i}/{k}_{k + 1}\n{decode_bases(r)}\n")
+
+
+def cli_cmd(out: str, fasta: str, extra: tuple = ()) -> list[str]:
+    return [sys.executable, "-m", "pbccs_tpu.cli",
+            "--skipChemistryCheck", "--chunkSize", str(CHUNK),
+            "--devices", str(DEVICES), "--memBudget", "1G",
+            "--reportFile", out + ".csv", *extra, out, fasta]
+
+
+def run_cli(cmd: list[str], timeout: float = 600.0):
+    return subprocess.run(cmd, env=_CHILD_ENV, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def journal_chunks(path: str) -> int:
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path, "rb") as f:
+        for line in f:
+            try:
+                n += json.loads(line).get("type") == "chunk"
+            except ValueError:
+                pass
+    return n
+
+
+def read_csv_total(path: str) -> int:
+    total = 0
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) == 3:
+                total += int(parts[1])
+    return total
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    tmp = tempfile.mkdtemp(prefix="pbccs_endurance_")
+    fasta = os.path.join(tmp, "cell.fasta")
+    write_workload(fasta)
+
+    try:
+        print("== phase 0: unfaulted reference (fleet, streamed) ==")
+        ref = os.path.join(tmp, "ref.bam")
+        r = run_cli(cli_cmd(ref, fasta))
+        check("reference run ok", r.returncode == 0,
+              r.stderr[-300:] if r.returncode else "")
+        ref_total = read_csv_total(ref + ".csv")
+        check("reference accounts every ZMW", ref_total == N_ZMWS,
+              f"{ref_total}/{N_ZMWS}")
+
+        print("== phase 1: kill -9 mid-stream (checkpoint armed) ==")
+        out = os.path.join(tmp, "out.bam")
+        ckpt = os.path.join(tmp, "cell.ckpt")
+        # a per-dispatch delay keeps the warm-cache run slow enough for
+        # the journal poll to catch it mid-stream (results unchanged)
+        proc = subprocess.Popen(
+            cli_cmd(out, fasta, ("--checkpoint", ckpt, "--faults",
+                                 "sched.dispatch:delay=0.4")),
+            env=_CHILD_ENV, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and proc.poll() is None:
+            if journal_chunks(ckpt) >= 2:
+                break
+            time.sleep(0.1)
+        journaled = journal_chunks(ckpt)
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(30)
+        check("killed with >= 2 journaled chunks", journaled >= 2,
+              f"{journaled} journaled")
+        check("kill was mid-run", proc.returncode != 0,
+              f"exit {proc.returncode}")
+        check("no torn output published", not os.path.exists(out))
+
+        print("== phase 2: resume + injected OOM + disk-full BAM ==")
+        # the resumed run recomputes the unjournaled chunks: the FIRST
+        # fleet dispatch OOMs (governor split, same device), and once
+        # every chunk is journaled the BAM writer hits a "full disk"
+        r = run_cli(cli_cmd(out, fasta, (
+            "--checkpoint", ckpt, "--resume", "--faults",
+            "sched.dispatch:oom@1*1,output.write:enospc~bam@1*1")))
+        check("disk-full run exits nonzero", r.returncode == 1,
+              f"exit {r.returncode}: {r.stderr[-300:]}")
+        check("oom handled by governor split",
+              "memory governor: capacity failure" in r.stderr
+              and "governor-split re-dispatch" in r.stderr)
+        check("no healthy batch quarantined",
+              "quarantined" not in r.stderr)
+        check("disk-full failure is structured",
+              "free disk space" in r.stderr and "bam write" in r.stderr)
+        check("no torn BAM published", not os.path.exists(out))
+        check("no temp file leaked", not os.path.exists(out + ".tmp"))
+        check("journal survives the disk-full failure",
+              journal_chunks(ckpt) >= journaled)
+
+        print("== phase 3: space freed -> final resume ==")
+        r = run_cli(cli_cmd(out, fasta, ("--checkpoint", ckpt,
+                                         "--resume")))
+        check("final resume ok", r.returncode == 0,
+              r.stderr[-300:] if r.returncode else "")
+        check("resume restored journaled chunks",
+              "restored" in r.stderr and "completed chunk" in r.stderr)
+        check("journal removed after success", not os.path.exists(ckpt))
+
+        print("== verdict: zero loss, byte-identity ==")
+        with open(ref, "rb") as a, open(out, "rb") as b:
+            check("BAM byte-identical to unfaulted run",
+                  a.read() == b.read())
+        check("report byte-identical to unfaulted run",
+              open(ref + ".csv").read() == open(out + ".csv").read())
+        out_total = read_csv_total(out + ".csv")
+        check("zero lost ZMWs", out_total == N_ZMWS,
+              f"{out_total}/{N_ZMWS}")
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    dt = time.monotonic() - t_start
+    print(f"endurance smoke: all checks passed in {dt:.1f}s "
+          f"(budget 120s scaled run)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,11 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/sched_smoke.py || exit 1
 echo "== fleet smoke (serve replicas behind ccs router: kill -9 + drain, zero lost/dup) =="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py || exit 1
 
+echo "== endurance smoke (scaled full-cell stream: OOM + ENOSPC + kill -9, zero loss) =="
+# the scaled run itself is budgeted <= 120 s warm (the smoke prints its
+# runtime); the wrapper allows cold-compile headroom
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/endurance_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
