@@ -1,18 +1,24 @@
 """`ccs analyze`: project-native static analysis.
 
-Three AST-based passes over the repository -- concurrency lint (lock
-discipline, blocking-under-lock, lock-order cycles), JAX/Pallas
-tracer hygiene, and cross-file registry drift (metrics/fault sites vs
-docs/DESIGN.md, CLI flags vs README, exception policy) -- plus a
-committed-baseline ratchet.  See docs/DESIGN.md "Static analysis" for
-the rule catalogue and pbccs_tpu/analysis/core.py for how to add a
-rule.  Entry points: `ccs analyze` (pbccs_tpu.analysis.cli) and
-tools/analyze_smoke.py (the tier-1 gate).
+Seven AST-based passes over the repository -- concurrency lint (lock
+discipline, blocking-under-lock, lock-order cycles), JAX/Pallas tracer
+hygiene, cross-file registry drift (metrics/fault sites/env toggles/
+flags vs docs, exception policy), and the interprocedural trio built
+on the project call graph (analysis/callgraph.py + dataflow.py):
+atomic-publish safety (exsafe), lease-release safety (leases), and
+wire-protocol conformance against serve/protocol.py's machine-readable
+spec (proto) -- plus a committed-baseline ratchet.  See docs/DESIGN.md
+"Static analysis" for the rule catalogue and
+pbccs_tpu/analysis/core.py for how to add a rule.  Entry points:
+`ccs analyze` (pbccs_tpu.analysis.cli) and tools/analyze_smoke.py (the
+tier-1 gate).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import pathlib
+from typing import Callable
 
 from pbccs_tpu.analysis.core import (  # noqa: F401 -- public API
     RULES,
@@ -24,27 +30,95 @@ from pbccs_tpu.analysis.core import (  # noqa: F401 -- public API
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class PassSpec:
+    """One registered analyzer pass: the unit of `--pass` selection,
+    per-pass baseline scoping, and the DESIGN.md pass catalogue."""
+
+    name: str
+    rules: tuple[str, ...]
+    run: Callable          # (sources, root, scoped) -> list[Finding]
+    repo_wide: bool = False   # needs the whole repo (+docs); skipped
+    # on path-scoped runs
+
+
+def _run_conc(sources, root, scoped):
+    from pbccs_tpu.analysis.conc import analyze_conc
+    return analyze_conc(sources)
+
+
+def _run_jax(sources, root, scoped):
+    from pbccs_tpu.analysis.jaxlint import analyze_jax
+    return analyze_jax(sources)
+
+
+def _run_exc(sources, root, scoped):
+    from pbccs_tpu.analysis.registry import analyze_exceptions
+    return analyze_exceptions(sources)
+
+
+def _run_registry(sources, root, scoped):
+    from pbccs_tpu.analysis.registry import analyze_registry
+    return analyze_registry(sources, root)
+
+
+def _run_exsafe(sources, root, scoped):
+    from pbccs_tpu.analysis.exsafe import analyze_exsafe
+    return analyze_exsafe(sources, scoped=scoped)
+
+
+def _run_leases(sources, root, scoped):
+    from pbccs_tpu.analysis.leases import analyze_leases
+    return analyze_leases(sources)
+
+
+def _run_proto(sources, root, scoped):
+    from pbccs_tpu.analysis.protolint import analyze_proto
+    return analyze_proto(sources, scoped=scoped)
+
+
+PASSES: dict[str, PassSpec] = {p.name: p for p in (
+    PassSpec("conc", ("CONC001", "CONC002", "CONC003"), _run_conc),
+    PassSpec("jax", ("JAX001", "JAX002", "JAX003", "JAX004"), _run_jax),
+    PassSpec("exc", ("EXC001", "EXC002"), _run_exc),
+    PassSpec("registry",
+             ("REG001", "REG002", "REG003", "REG004", "REG005",
+              "REG006", "REG007", "REG008", "REG009"),
+             _run_registry, repo_wide=True),
+    PassSpec("exsafe", ("ATM001", "ATM002"), _run_exsafe),
+    PassSpec("leases", ("LSE001", "LSE002"), _run_leases),
+    PassSpec("proto", ("PRO001", "PRO002", "PRO003"), _run_proto),
+)}
+
+
+def pass_for_rule(rule: str) -> str | None:
+    for spec in PASSES.values():
+        if rule in spec.rules:
+            return spec.name
+    return None
+
+
 def run_passes(root: pathlib.Path,
                paths: list[pathlib.Path] | None = None,
-               rules: set[str] | None = None) -> list["Finding"]:
-    """Run every analyzer over `root` (or just `paths`), returning
-    findings with inline suppressions already applied (baseline
-    filtering is the CLI's job).  `rules` filters to a subset of ids."""
-    from pbccs_tpu.analysis.conc import analyze_conc
-    from pbccs_tpu.analysis.jaxlint import analyze_jax
-    from pbccs_tpu.analysis.registry import (
-        analyze_exceptions,
-        analyze_registry,
-    )
-
+               rules: set[str] | None = None,
+               passes: set[str] | None = None) -> list["Finding"]:
+    """Run the registered analyzers over `root` (or just `paths`),
+    returning findings with inline suppressions already applied
+    (baseline filtering is the CLI's job).  `rules` filters to a subset
+    of ids; `passes` to a subset of registered pass names.  Repo-wide
+    passes (registry drift, protocol drift) are skipped on path-scoped
+    runs -- they read docs and cross-file state a file subset cannot
+    represent."""
+    scoped = paths is not None
     sources, findings = load_sources(root, paths)
-    findings += analyze_conc(sources)
-    findings += analyze_jax(sources)
-    findings += analyze_exceptions(sources)
-    if paths is None:
-        # drift checks read the whole repo + docs; path-scoped runs
-        # (tests over fixtures) skip them
-        findings += analyze_registry(sources, root)
+    for spec in PASSES.values():
+        if passes is not None and spec.name not in passes:
+            continue
+        if rules is not None and not rules.intersection(spec.rules):
+            continue
+        if scoped and spec.repo_wide:
+            continue
+        findings += spec.run(sources, root, scoped)
     findings = apply_inline_suppressions(findings, sources)
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]

@@ -36,6 +36,7 @@ class Suppression:
     path: str
     match: str = ""
     reason: str = ""
+    pass_name: str = ""      # owning pass ("conc", "leases", ...)
 
     def covers(self, f: Finding) -> bool:
         return (f.rule == self.rule and f.path == self.path
@@ -43,10 +44,15 @@ class Suppression:
 
 
 class BaselineError(ValueError):
-    """Malformed baseline file (bad TOML or missing required keys)."""
+    """Malformed baseline file (bad TOML, missing required keys, or an
+    entry keyed on a rule/pass that no longer exists -- a retired rule
+    makes every one of its suppressions permanently stale, so that is
+    an error at load time, not a silent ANA001 later)."""
 
 
 def load_baseline(path: pathlib.Path) -> list[Suppression]:
+    from pbccs_tpu.analysis import PASSES, RULES, pass_for_rule
+
     if not path.exists():
         return []
     try:
@@ -56,14 +62,34 @@ def load_baseline(path: pathlib.Path) -> list[Suppression]:
     out: list[Suppression] = []
     for i, entry in enumerate(data.get("suppress", [])):
         try:
-            out.append(Suppression(
+            sup = Suppression(
                 rule=entry["rule"], path=entry["path"],
                 match=entry.get("match", ""),
-                reason=entry.get("reason", "")))
+                reason=entry.get("reason", ""),
+                pass_name=entry.get("pass", ""))
         except (KeyError, TypeError) as e:
             raise BaselineError(
                 f"{path}: suppress[{i}] needs string keys rule/path "
-                f"(+optional match/reason): {e!r}") from None
+                f"(+optional match/reason/pass): {e!r}") from None
+        if sup.rule not in RULES:
+            raise BaselineError(
+                f"{path}: suppress[{i}] names unknown rule "
+                f"{sup.rule!r} (retired rules must take their "
+                "suppressions with them)")
+        if sup.pass_name:
+            spec = PASSES.get(sup.pass_name)
+            if spec is None:
+                raise BaselineError(
+                    f"{path}: suppress[{i}] names unknown pass "
+                    f"{sup.pass_name!r}")
+            if sup.rule not in spec.rules:
+                raise BaselineError(
+                    f"{path}: suppress[{i}] says rule {sup.rule} "
+                    f"belongs to pass {sup.pass_name!r} but that pass "
+                    f"owns {spec.rules}")
+        else:
+            sup.pass_name = pass_for_rule(sup.rule) or ""
+        out.append(sup)
     return out
 
 

@@ -20,7 +20,7 @@ import pathlib
 import sys
 import time
 
-from pbccs_tpu.analysis import RULES, run_passes
+from pbccs_tpu.analysis import PASSES, RULES, run_passes
 from pbccs_tpu.analysis.baseline import (
     BaselineError,
     apply_baseline,
@@ -42,7 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ccs analyze",
         description="Project-native static analysis: concurrency lint, "
-                    "JAX tracer hygiene, registry drift.")
+                    "JAX tracer hygiene, registry drift, atomic-publish "
+                    "safety, lease-release safety, wire-protocol "
+                    "conformance.")
     p.add_argument("--root", default=None,
                    help="Repository root to analyze (default: nearest "
                         "ancestor of CWD containing pbccs_tpu/).")
@@ -55,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Output format. Default = %(default)s")
     p.add_argument("--rules", default=None, metavar="IDS",
                    help="Comma-separated rule ids to run (default: all).")
+    p.add_argument("--pass", dest="passes", default=None, metavar="NAMES",
+                   help="Comma-separated pass names to run "
+                        f"({', '.join(sorted(PASSES))}); baseline "
+                        "entries of other passes are out of scope for "
+                        "staleness.")
     p.add_argument("--list-rules", action="store_true",
                    help="Print the rule catalogue and exit.")
     p.add_argument("--emit-tables", action="store_true",
@@ -102,27 +109,53 @@ def _run(args) -> int:
         from pbccs_tpu.analysis.registry import (
             _table_entries,
             collect_env_reads,
+            collect_fault_kinds,
             collect_fault_sites,
+            collect_flag_defs,
             collect_metrics,
             render_env_table,
+            render_fault_kinds_table,
+            render_flags_table,
             render_metrics_table,
             render_sites_table,
         )
 
         sources, _ = load_sources(root)
         pkg = [s for s in sources if s.rel.startswith("pbccs_tpu/")]
+        design = root / "docs" / "DESIGN.md"
+        design_text = design.read_text() if design.exists() else ""
+
+        def existing(marker):
+            return _table_entries(design_text, marker)
+
         print(render_metrics_table(collect_metrics(pkg)))
         print()
         print(render_sites_table(collect_fault_sites(pkg)))
         print()
-        design = root / "docs" / "DESIGN.md"
-        existing = _table_entries(
-            design.read_text() if design.exists() else "", "env-table")
-        print(render_env_table(collect_env_reads(pkg), existing))
+        print(render_env_table(collect_env_reads(pkg),
+                               existing("env-table")))
+        print()
+        kinds, kinds_path, _ = collect_fault_kinds(pkg)
+        print(render_fault_kinds_table(kinds, kinds_path,
+                                       existing("fault-kinds-table")))
+        print()
+        print(render_flags_table(collect_flag_defs(pkg),
+                                 existing("flags-table")))
         return 0
 
     rules = ({r.strip() for r in args.rules.split(",") if r.strip()}
              if args.rules else None)
+    passes = None
+    if args.passes:
+        passes = {p.strip() for p in args.passes.split(",") if p.strip()}
+        unknown = passes - set(PASSES)
+        if unknown:
+            print(f"ccs analyze: unknown pass(es) "
+                  f"{', '.join(sorted(unknown))} (have: "
+                  f"{', '.join(sorted(PASSES))})", file=sys.stderr)
+            return 2
+        pass_rules = {r for name in passes for r in PASSES[name].rules}
+        rules = pass_rules if rules is None else rules & pass_rules
     paths = None
     if args.paths:
         paths = []
@@ -135,7 +168,7 @@ def _run(args) -> int:
                       file=sys.stderr)
                 return 2
             paths.append(p)
-    findings = run_passes(root, paths=paths, rules=rules)
+    findings = run_passes(root, paths=paths, rules=rules, passes=passes)
 
     n_suppressed = 0
     if not args.no_baseline:

@@ -1,9 +1,12 @@
 """Shared infrastructure for the `ccs analyze` static-analysis suite.
 
-The analyzers (conc, jaxlint, registry) are pure-AST passes: they parse
-the repository's sources, never import them, so `ccs analyze` runs in a
-couple of seconds with no device, no jax, and no side effects.  This
-module owns what every pass shares:
+The analyzers (conc, jaxlint, registry, exsafe, leases, protolint) are
+pure-AST passes: they parse the repository's sources, never import
+them, so `ccs analyze` runs in seconds with no device, no jax, and no
+side effects.  The interprocedural passes additionally share the call
+graph in callgraph.py and the path walker in dataflow.py; the pass
+registry itself lives in __init__.py::PASSES.  This module owns what
+every pass shares:
 
   * Finding -- one structured result (file:line, rule id, message);
   * SourceFile -- a parsed source with its inline-suppression map
@@ -56,9 +59,30 @@ RULES = {
               "DESIGN.md env-toggle table",
     "REG007": "env toggle listed in the DESIGN.md env-toggle table but "
               "read by no code",
+    "REG008": "fault-kind vocabulary (faults.FAULT_KINDS) drifted from "
+              "the DESIGN.md fault-kinds table",
+    "REG009": "CLI flag defined by a pbccs_tpu argument parser but "
+              "missing from the DESIGN.md flags table",
     "EXC001": "bare `except:` clause",
     "EXC002": "silent `except Exception/BaseException: pass` without a "
               "stated reason",
+    "ATM001": "user-visible output written without tmp+fsync+rename "
+              "(route through resources.atomic_output or a registered "
+              "journal contract)",
+    "ATM002": "half an atomic publish: temp-staged write never "
+              "renamed/fsynced, or a rename publish with no fsync in "
+              "scope",
+    "LSE001": "acquired lease/slot/fd not released on some "
+              "return/fall-through path (or a scope factory called "
+              "without `with`)",
+    "LSE002": "acquired lease/slot/fd leaks on an exception path (no "
+              "releasing finally/except in the function)",
+    "PRO001": "wire-protocol drift against the serve/protocol.py "
+              "WIRE_* spec tables (verbs/replies/errors/handlers)",
+    "PRO002": "protocol handler completes a request zero times or "
+              "more than once on some path",
+    "PRO003": "`*_locked` ownership contract violated (called without "
+              "the owning lock, or re-acquires it inside)",
     "ANA001": "stale baseline suppression matching no current finding",
     "ANA002": "source file fails to parse",
 }

@@ -680,27 +680,19 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
                 for result in tally.results:
                     uposs.append(bw.write(writer_record(result)))
                 bw_handle = bw
-            # same atomicity contract as the BAM: build the index in a
-            # same-dir temp file and rename into place, so an ENOSPC
-            # mid-index never publishes a torn .pbi beside a valid BAM
+            # PbiBuilder publishes atomically itself (tmp+fsync+rename
+            # inside close(), OutputWriteError on ENOSPC) -- the same
+            # contract as the BamWriter beside it
             pbi_path = args.output + ".pbi"
-            try:
-                with PbiBuilder(pbi_path + ".tmp") as pbi:
-                    for result, upos in zip(tally.results, uposs):
-                        movie = result.id.split("/")[0]
-                        hole = int(result.id.split("/")[1])
-                        pbi.add_record(
-                            read_group_numeric_id(
-                                make_read_group_id(movie, "CCS")),
-                            -1, -1, hole, result.predicted_accuracy, 0,
-                            bw_handle.voffset(upos))
-                os.replace(pbi_path + ".tmp", pbi_path)
-            except OSError as e:
-                try:
-                    os.remove(pbi_path + ".tmp")
-                except OSError:
-                    pass  # best-effort cleanup; the .tmp suffix marks it
-                raise OutputWriteError("pbi", pbi_path, 0, e) from e
+            with PbiBuilder(pbi_path) as pbi:
+                for result, upos in zip(tally.results, uposs):
+                    movie = result.id.split("/")[0]
+                    hole = int(result.id.split("/")[1])
+                    pbi.add_record(
+                        read_group_numeric_id(
+                            make_read_group_id(movie, "CCS")),
+                        -1, -1, hole, result.predicted_accuracy, 0,
+                        bw_handle.voffset(upos))
 
     write_results_report_file(args.reportFile, tally)
     if journal is not None:

@@ -108,7 +108,9 @@ def main(argv=None) -> int:
         if args.output == "-":
             print(text)
         else:
-            with open(args.output, "w") as fh:
+            from pbccs_tpu.resilience.resources import atomic_output
+
+            with atomic_output(args.output, "contract") as fh:
                 fh.write(text)
         return 0
     return run_resolved_tool_contract(args.rtc)

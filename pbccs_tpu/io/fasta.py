@@ -34,7 +34,13 @@ def read_fasta(path: str) -> Iterator[tuple[str, str]]:
 
 
 def write_fasta(path: str, records, line_width: int = 70) -> None:
-    with open(path, "w") as f:
+    # same publish discipline as the BAM/report writers: stream into a
+    # same-dir temp file, fsync, rename -- a crash or ENOSPC mid-write
+    # never leaves a torn FASTA under the output path (ccs-analyze
+    # ATM001), and the failure surfaces as a structured OutputWriteError
+    from pbccs_tpu.resilience.resources import atomic_output
+
+    with atomic_output(path, "fasta") as f:
         for name, seq in records:
             f.write(f">{name}\n")
             for i in range(0, len(seq), line_width):

@@ -34,7 +34,12 @@ def read_group_numeric_id(rg_id: str) -> int:
 
 
 class PbiBuilder:
-    """Accumulates one index row per BAM record; write() emits the .pbi."""
+    """Accumulates one index row per BAM record; close() publishes the
+    .pbi ATOMICALLY (tmp+fsync+rename via resources.atomic_output), the
+    same durability contract as the companion BamWriter: an ENOSPC or
+    crash mid-index never leaves a torn .pbi beside a valid BAM, and a
+    filesystem failure surfaces as a structured OutputWriteError
+    (sink="pbi")."""
 
     def __init__(self, path: str):
         self._path = path
@@ -69,7 +74,9 @@ class PbiBuilder:
         payload.write(np.asarray(self.read_quals, "<f4").tobytes())
         payload.write(np.asarray(self.ctxt_flags, "u1").tobytes())
         payload.write(np.asarray(self.offsets, "<u8").tobytes())
-        with open(self._path, "wb") as fh:
+        from pbccs_tpu.resilience.resources import atomic_output
+
+        with atomic_output(self._path, "pbi", mode="wb") as fh:
             w = BgzfWriter(fh)
             w.write(payload.getvalue())
             w.close()
@@ -77,8 +84,11 @@ class PbiBuilder:
     def __enter__(self) -> "PbiBuilder":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, *exc) -> None:
+        # publish only on clean exit: an exception mid-accumulation must
+        # not atomically rename a PARTIAL index over a previous valid one
+        if exc_type is None:
+            self.close()
 
 
 class PbiIndex:

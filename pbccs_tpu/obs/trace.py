@@ -135,7 +135,11 @@ class Tracer:
         return out
 
     def write_json(self, path: str) -> None:
-        with open(path, "w") as f:
+        # atomic publish (ccs-analyze ATM001): a truncated trace JSON is
+        # unreadable by the Chrome viewer, so never leave a torn one
+        from pbccs_tpu.resilience.resources import atomic_output
+
+        with atomic_output(path, "trace") as f:
             json.dump(self.to_chrome(), f)
 
 

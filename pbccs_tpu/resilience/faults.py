@@ -84,12 +84,21 @@ class FaultSpecError(ValueError):
     """A fault spec string violates the grammar."""
 
 
+# the injectable failure vocabulary, one name per shaped recovery path:
+# error (transient raise), delay (latency), corrupt (payload bytes),
+# oom (capacity-shaped RESOURCE_EXHAUSTED -> governor split), enospc
+# (disk-full OSError -> atomic-writer recovery).  This tuple is the
+# single source of truth -- the spec parser validates against it and
+# `ccs analyze` (REG008) keeps the DESIGN.md fault-kinds table in sync.
+FAULT_KINDS = ("error", "delay", "corrupt", "oom", "enospc")
+
+
 @dataclasses.dataclass
 class FaultSpec:
     """One parsed spec entry (see module docstring for the grammar)."""
 
     site: str
-    kind: str                  # "error" | "delay" | "corrupt"
+    kind: str                  # one of FAULT_KINDS
     arg: str = ""              # error marker / delay seconds
     key: str | None = None     # fire only when a caller key matches
     at: int | None = None      # fire only on the at-th eligible call
@@ -129,10 +138,10 @@ def parse_faults(text: str) -> list[FaultSpec]:
                     f"bad fault modifier {mark}{val!r} in {raw!r}"
                 ) from None
         kind, _, arg = rest.partition("=")
-        if kind not in ("error", "delay", "corrupt", "oom", "enospc"):
+        if kind not in FAULT_KINDS:
             raise FaultSpecError(
                 f"bad fault kind {kind!r} in {raw!r} "
-                "(want error|delay|corrupt|oom|enospc)")
+                f"(want {'|'.join(FAULT_KINDS)})")
         specs.append(FaultSpec(site=site, kind=kind, arg=arg, **spec_kw))
     return specs
 

@@ -104,6 +104,49 @@ ERR_CLOSED = "closed"
 ERR_INTERNAL = "internal"
 
 
+# ------------------------------------------------------------------ wire spec
+#
+# Machine-readable protocol state machine.  `ccs analyze`'s protolint
+# pass (pbccs_tpu/analysis/protolint.py) parses these tables from the
+# AST -- never importing this module -- and statically checks
+# server.py / router.py / client.py against them: every verb a client
+# tier can send has a registered handler on the serving tier's
+# dispatch, every reply type and error code that reaches a wire is
+# declared here, and every handler completes-or-fails a request
+# exactly once, only while owning it.  Values resolve through the
+# VERB_*/TYPE_*/ERR_* constants above, so the spec cannot drift from
+# the names the code ships (drift either way is a PRO001 finding).
+#
+# Per-verb fields:
+#   handler  the session method that serves the verb (None = handled
+#            inline by the dispatch loop itself, e.g. ping/pong);
+#   replies  reply types the verb may terminate with (any verb may
+#            additionally fail with TYPE_ERROR);
+#   ownership "callback" marks the ownership-transfer rule: the handler
+#            acquires the session in-flight slot and hands completion
+#            (reply + slot release) to a registered callback -- the
+#            exactly-once and lease obligations move with it.
+
+WIRE_VERBS = {
+    VERB_SUBMIT: {"handler": "_on_submit",
+                  "replies": (TYPE_RESULT, TYPE_ERROR),
+                  "ownership": "callback"},
+    VERB_STATUS: {"handler": "_on_status", "replies": (TYPE_STATUS,)},
+    VERB_METRICS: {"handler": "_on_metrics", "replies": (TYPE_METRICS,)},
+    VERB_TRACE: {"handler": "_on_trace",
+                 "replies": (TYPE_TRACE, TYPE_ERROR)},
+    VERB_PING: {"handler": None, "replies": (TYPE_PONG,)},
+}
+
+WIRE_REPLIES = (TYPE_RESULT, TYPE_ERROR, TYPE_STATUS, TYPE_METRICS,
+                TYPE_TRACE, TYPE_PONG, TYPE_CLOSED)
+
+# server->client types no verb elicits (drain / idle-reap notices)
+WIRE_UNSOLICITED = (TYPE_CLOSED,)
+
+WIRE_ERRORS = (ERR_BAD_REQUEST, ERR_OVERLOADED, ERR_CLOSED, ERR_INTERNAL)
+
+
 class ProtocolError(ValueError):
     """A message violates the wire contract (bad JSON, wrong field types,
     missing required fields)."""

@@ -1,0 +1,16 @@
+"""Negative ATM001: the registered atomic helper owns the
+tmp+fsync+rename discipline; read-mode opens never flag."""
+
+import json
+
+from pbccs_tpu.resilience.resources import atomic_output
+
+
+def publish_report(path, payload):
+    with atomic_output(path, "report") as fh:
+        json.dump(payload, fh)
+
+
+def load_report(path):
+    with open(path) as fh:
+        return json.load(fh)

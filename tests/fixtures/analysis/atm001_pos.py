@@ -1,0 +1,10 @@
+"""Positive ATM001: a user-visible artifact published with a direct
+write-mode open -- a crash or ENOSPC mid-write leaves a torn file
+under the final path."""
+
+import json
+
+
+def publish_report(path, payload):
+    with open(path, "w") as fh:      # ATM001 fires here
+        json.dump(payload, fh)

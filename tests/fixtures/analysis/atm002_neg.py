@@ -1,0 +1,20 @@
+"""Negative ATM002: the full tmp+fsync+rename idiom, stage and publish
+split across methods of one class (the BamWriter shape)."""
+
+import os
+
+
+class Writer:
+    def __init__(self, path):
+        self.path = path
+        self._tmp = path + ".tmp"
+        self._fh = open(self._tmp, "wb")
+
+    def write(self, data):
+        self._fh.write(data)
+
+    def close(self):
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        os.replace(self._tmp, self.path)

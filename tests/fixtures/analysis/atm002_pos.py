@@ -1,0 +1,11 @@
+"""Positive ATM002: temp-staged write renamed into place without an
+fsync -- the rename can land while the data does not."""
+
+import os
+
+
+def publish(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:      # ATM002: staged, never fsynced
+        fh.write(data)
+    os.replace(tmp, path)

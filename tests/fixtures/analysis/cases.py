@@ -15,5 +15,11 @@ AST_CASES = {
     "JAX004": ("jax004_pos.py", "jax004_neg.py"),
     "EXC001": ("exc001_pos.py", "exc001_neg.py"),
     "EXC002": ("exc002_pos.py", "exc002_neg.py"),
+    "ATM001": ("atm001_pos.py", "atm001_neg.py"),
+    "ATM002": ("atm002_pos.py", "atm002_neg.py"),
+    "LSE001": ("lse001_pos.py", "lse001_neg.py"),
+    "LSE002": ("lse002_pos.py", "lse002_neg.py"),
+    "PRO002": ("pro002_pos.py", "pro002_neg.py"),
+    "PRO003": ("pro003_pos.py", "pro003_neg.py"),
     "ANA002": ("ana002_pos.py", None),   # any parseable file is the neg
 }

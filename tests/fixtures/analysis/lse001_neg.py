@@ -1,0 +1,17 @@
+"""Negative LSE001/LSE002: every path releases -- directly, or by
+transferring the lease to a releasing callback (the executor idiom)."""
+
+
+def prepare(budget, batch, submit):
+    lease = budget.admit(batch.nbytes)
+    if lease is None:
+        return None              # acquire aborted: nothing held
+    if batch.empty:
+        lease.release()
+        return None
+
+    def done(fut):
+        lease.release()          # the callback owns the release now
+
+    submit(batch, callback=done)
+    return batch

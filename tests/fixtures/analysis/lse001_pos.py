@@ -1,0 +1,10 @@
+"""Positive LSE001: a host-budget lease acquired and then abandoned on
+an early-return path (the ordered-emission deadlock bug class)."""
+
+
+def prepare(budget, batch):
+    lease = budget.admit(batch.nbytes)
+    if batch.empty:
+        return None              # LSE001: lease still held here
+    lease.release()
+    return batch

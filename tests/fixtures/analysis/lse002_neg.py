@@ -1,0 +1,10 @@
+"""Negative LSE002: the finally releases on every path, exception
+included."""
+
+
+def charge(budget, batch, polish):
+    lease = budget.admit(batch.nbytes)
+    try:
+        polish(batch)
+    finally:
+        lease.release()

@@ -1,0 +1,8 @@
+"""Positive LSE002: calls run while the lease is held, and no try in
+the function releases it on an exception path."""
+
+
+def charge(budget, batch, polish):
+    lease = budget.admit(batch.nbytes)
+    polish(batch)                # may raise: the lease would leak
+    lease.release()

@@ -1,0 +1,17 @@
+"""Negative PRO002: exactly one completion per path -- a direct reply,
+or a registered completion callback (the ownership-transfer rule)."""
+
+
+class Session:
+    def send(self, msg):
+        self.transport.write(msg)
+
+    def _on_query(self, msg):
+        if msg.get("bad"):
+            self.send({"type": "error"})
+            return
+
+        def on_done(result):
+            self.send({"type": "result"})
+
+        self.engine.submit(msg, callback=on_done)

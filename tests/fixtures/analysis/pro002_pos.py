@@ -1,0 +1,12 @@
+"""Positive PRO002: the error path falls through into the success
+reply, completing the request twice (exactly-once emission)."""
+
+
+class Session:
+    def send(self, msg):
+        self.transport.write(msg)
+
+    def _on_query(self, msg):
+        if msg.get("bad"):
+            self.send({"type": "error"})     # missing return
+        self.send({"type": "result"})        # PRO002: double on bad path

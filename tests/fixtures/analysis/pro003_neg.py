@@ -1,0 +1,21 @@
+"""Negative PRO003: _locked helpers called under the owning lock, and
+a _locked helper calling a sibling (the contract propagates)."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = {}
+
+    def _complete_locked(self, rid):
+        self._requests.pop(rid, None)
+
+    def _sweep_locked(self):
+        for rid in list(self._requests):
+            self._complete_locked(rid)   # caller-is-_locked: fine
+
+    def finish(self, rid):
+        with self._lock:
+            self._complete_locked(rid)

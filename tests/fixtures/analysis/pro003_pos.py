@@ -1,0 +1,17 @@
+"""Positive PRO003: a completion helper with the _locked suffix called
+without holding the owning lock -- completing a request the caller
+does not own."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = {}
+
+    def _complete_locked(self, rid):
+        self._requests.pop(rid, None)
+
+    def finish(self, rid):
+        self._complete_locked(rid)       # PRO003: lock not held

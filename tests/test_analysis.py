@@ -60,7 +60,8 @@ def test_every_ast_rule_has_fixtures():
     """Adding a rule without fixtures fails here (the DESIGN.md 'how to
     add a rule' contract)."""
     constructed = {"REG001", "REG002", "REG003", "REG004", "REG005",
-                   "REG006", "REG007", "ANA001"}
+                   "REG006", "REG007", "REG008", "REG009", "PRO001",
+                   "ANA001"}
     missing = set(RULES) - set(AST_CASES) - constructed
     assert not missing, f"rules without fixture coverage: {missing}"
 
@@ -156,6 +157,9 @@ def test_registry_green_when_tables_match(tmp_path):
         <!-- ccs-analyze:env-table:begin -->
         | `PBCCS_REAL_TOGGLE` | a real toggle | `pbccs_tpu/mod.py` |
         <!-- ccs-analyze:env-table:end -->
+        <!-- ccs-analyze:flags-table:begin -->
+        | `--real` | a real flag | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:flags-table:end -->
     """))
     (root / "README.md").write_text("Run with `--real`.\n")
     assert [f for f in run_passes(root)

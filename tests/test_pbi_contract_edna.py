@@ -15,6 +15,24 @@ from pbccs_tpu.io.pbi import PbiBuilder, PbiIndex, read_group_numeric_id
 from pbccs_tpu.models.edna import EdnaEvaluator, EdnaModelParams
 
 
+def test_pbi_publishes_atomically(tmp_path):
+    """close() stages through tmp+fsync+rename: an exception inside the
+    with-body must publish NOTHING (and must not clobber a previous
+    valid index), and a clean exit leaves no temp file behind."""
+    pbi_path = str(tmp_path / "x.bam.pbi")
+    with pytest.raises(ValueError):
+        with PbiBuilder(pbi_path) as pbi:
+            pbi.add_record(1, -1, -1, 0, 0.9, 0, 1)
+            raise ValueError("mid-accumulation failure")
+    assert not os.path.exists(pbi_path), \
+        "a partial .pbi must never be published"
+    assert not os.path.exists(pbi_path + ".tmp")
+    with PbiBuilder(pbi_path) as pbi:
+        pbi.add_record(1, -1, -1, 0, 0.9, 0, 1)
+    assert os.path.exists(pbi_path)
+    assert not os.path.exists(pbi_path + ".tmp")
+
+
 def test_pbi_roundtrip_and_virtual_offsets(tmp_path, rng):
     bam_path = str(tmp_path / "x.bam")
     pbi_path = bam_path + ".pbi"

@@ -5,8 +5,10 @@ Three assertions, mirroring the acceptance contract:
 
   1. the repository analyzes CLEAN against the committed baseline
      (exit 0), i.e. no unsuppressed finding and no stale suppression;
-  2. the full run stays under 30 s (it is pure AST; a blowup here means
-     a pass grew an accidental O(n^2));
+  2. the full run stays under the 60 s analyzer-runtime budget (pure
+     AST, but the interprocedural passes build a whole-program call
+     graph -- a blowup here means a pass grew an accidental O(n^2) and
+     the suite would stop being tier-1-fast);
   3. every AST rule still FIRES on its positive fixture -- a refactor
      that silently lobotomizes a pass fails CI even though the repo
      "looks clean".
@@ -28,7 +30,7 @@ from pbccs_tpu.analysis import run_passes  # noqa: E402
 from pbccs_tpu.analysis.cli import run_analyze  # noqa: E402
 
 FIXTURES = REPO / "tests" / "fixtures" / "analysis"
-BUDGET_S = 30.0
+BUDGET_S = 60.0
 
 
 def _load_cases() -> dict:

@@ -9,10 +9,11 @@ cd "$(dirname "$0")/.."
 echo "== compileall gate =="
 python -m compileall -q pbccs_tpu tools || exit 1
 
-echo "== static analysis (ccs analyze: lock discipline / tracer hygiene / registry drift) =="
-# clean vs the committed baseline, <30s, and every rule still fires on
-# its positive fixture; runtime is printed by the smoke itself
-timeout -k 10 120 python tools/analyze_smoke.py || exit 1
+echo "== static analysis (ccs analyze: conc / jax / registry / exsafe / leases / proto) =="
+# clean vs the committed baseline, <60s analyzer-runtime budget, and
+# every rule still fires on its positive fixture; runtime is printed
+# by the smoke itself
+timeout -k 10 180 python tools/analyze_smoke.py || exit 1
 
 echo "== ruff (style gate; import order advisory) =="
 if command -v ruff >/dev/null 2>&1; then
