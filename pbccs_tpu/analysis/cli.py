@@ -66,8 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Print the rule catalogue and exit.")
     p.add_argument("--emit-tables", action="store_true",
                    help="Print regenerated DESIGN.md metrics/fault-site/"
-                        "env-toggle tables and exit (paste between the "
-                        "ccs-analyze markers).")
+                        "span/env-toggle tables and exit (paste between "
+                        "the ccs-analyze markers).")
     p.add_argument("paths", nargs="*",
                    help="Specific files to analyze (default: the whole "
                         "repo).  Path-scoped runs skip the repo-wide "
@@ -113,11 +113,13 @@ def _run(args) -> int:
             collect_fault_sites,
             collect_flag_defs,
             collect_metrics,
+            collect_spans,
             render_env_table,
             render_fault_kinds_table,
             render_flags_table,
             render_metrics_table,
             render_sites_table,
+            render_spans_table,
         )
 
         sources, _ = load_sources(root)
@@ -131,6 +133,9 @@ def _run(args) -> int:
         print(render_metrics_table(collect_metrics(pkg)))
         print()
         print(render_sites_table(collect_fault_sites(pkg)))
+        print()
+        print(render_spans_table(collect_spans(pkg),
+                                 existing("spans-table")))
         print()
         print(render_env_table(collect_env_reads(pkg),
                                existing("env-table")))

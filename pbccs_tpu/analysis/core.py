@@ -63,6 +63,9 @@ RULES = {
               "the DESIGN.md fault-kinds table",
     "REG009": "CLI flag defined by a pbccs_tpu argument parser but "
               "missing from the DESIGN.md flags table",
+    "REG010": "trace span name drifted from the DESIGN.md span table "
+              "(recorded but undocumented, or documented but never "
+              "recorded)",
     "EXC001": "bare `except:` clause",
     "EXC002": "silent `except Exception/BaseException: pass` without a "
               "stated reason",

@@ -58,7 +58,8 @@ TIER_MODULES = ("pbccs_tpu/serve/server.py",
                 "pbccs_tpu/serve/router.py",
                 "pbccs_tpu/serve/client.py")
 
-_CONST_PREFIXES = {"verbs": "VERB_", "replies": "TYPE_", "errors": "ERR_"}
+_CONST_PREFIXES = {"verbs": "VERB_", "replies": "TYPE_", "errors": "ERR_",
+                   "fields": "FIELD_", "field keys": "KEY_"}
 
 
 # ------------------------------------------------------------- spec parsing
@@ -69,6 +70,9 @@ class WireSpec:
         self.replies: set[str] = set()
         self.errors: set[str] = set()
         self.unsolicited: set[str] = set()
+        # optional cross-cutting frame fields (trace context):
+        # {field: {"keys": (...), "verbs": (...)}}
+        self.fields: dict[str, dict] = {}
         self.lines: dict[str, int] = {}     # table name -> lineno
 
 
@@ -103,7 +107,7 @@ def parse_spec(src: SourceFile) -> tuple[WireSpec | None, Finding | None]:
             continue
         name = node.targets[0].id
         if name not in ("WIRE_VERBS", "WIRE_REPLIES", "WIRE_ERRORS",
-                        "WIRE_UNSOLICITED"):
+                        "WIRE_UNSOLICITED", "WIRE_FIELDS"):
             continue
         try:
             value = _eval_node(node.value, consts)
@@ -122,6 +126,8 @@ def parse_spec(src: SourceFile) -> tuple[WireSpec | None, Finding | None]:
             spec.errors = set(value)
         elif name == "WIRE_UNSOLICITED":
             spec.unsolicited = set(value)
+        elif name == "WIRE_FIELDS":
+            spec.fields = value
     if "WIRE_VERBS" not in found:
         return None, Finding(
             "PRO001", src.rel, 1,
@@ -209,8 +215,22 @@ def _check_drift(sources: list[SourceFile]) -> list[Finding]:
     proto_consts = module_str_constants(proto.tree)
 
     # constants <-> spec membership (within protocol.py itself)
+    field_keys: set[str] = set()
+    for entry in spec.fields.values():
+        if isinstance(entry, dict):
+            field_keys.update(entry.get("keys", ()))
     sections = {"verbs": set(spec.verbs), "replies": spec.replies,
-                "errors": spec.errors}
+                "errors": spec.errors, "fields": set(spec.fields),
+                "field keys": field_keys}
+    # a field's carrier verbs must themselves be spec verbs
+    for field, entry in sorted(spec.fields.items()):
+        carriers = entry.get("verbs", ()) if isinstance(entry, dict) else ()
+        for verb in carriers:
+            if verb not in spec.verbs:
+                findings.append(Finding(
+                    "PRO001", proto.rel, spec.lines.get("WIRE_FIELDS", 1),
+                    f"wire field {field!r} names carrier verb {verb!r} "
+                    "that the wire spec does not declare"))
     for section, prefix in _CONST_PREFIXES.items():
         declared = {v for k, v in proto_consts.items()
                     if k.startswith(prefix)}

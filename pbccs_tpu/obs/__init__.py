@@ -1,15 +1,25 @@
 """Unified observability layer: metrics, traces, and profiling hooks.
 
-Three cooperating pieces, all host-side and dependency-free (no jax
+Five cooperating pieces, all host-side and dependency-free (no jax
 import at module load, so the CLI's argument errors stay fast):
 
   * obs.metrics -- a thread-safe MetricsRegistry (counters, gauges,
     histograms with fixed log-scale buckets) with Prometheus text
-    exposition and per-registry MeasurementScope windows (concurrent
-    measurement windows instead of one global reset);
+    exposition, per-registry MeasurementScope windows (concurrent
+    measurement windows instead of one global reset), a per-name series
+    cap (label-cardinality armor), and the text-level federation
+    helpers the router's fleet scrape is built from;
   * obs.trace -- per-ZMW span trees (filter -> draft -> polish rounds ->
-    emit) with wall vs device-wait attribution, exported as
-    Chrome-trace/Perfetto JSON (`--trace-out`, serve `trace` verb);
+    emit) with wall vs device-wait attribution AND cross-process trace
+    context (trace_id / span_id / remote_parent riding the serve
+    protocol's `trace` submit field), exported as Chrome-trace/Perfetto
+    JSON (`--trace-out`, serve `trace` verb; tools/trace_merge.py
+    assembles the fleet-wide timeline);
+  * obs.flight -- the refine-loop flight recorder: per-round
+    convergence/occupancy/padding gauges plus a bounded ring buffer
+    dumped on quarantine / capacity splits;
+  * obs.httpexp -- the stdlib-HTTP `/metrics` scrape endpoint
+    (`--metricsPort` on `ccs serve` and `ccs router`);
   * obs.profiling -- the opt-in jax.profiler capture hook
     (`--profile-dir`).
 

@@ -1,0 +1,70 @@
+"""Stdlib-HTTP Prometheus scrape endpoint (`--metricsPort`).
+
+The NDJSON `metrics` verb serves tooling that already speaks the serve
+protocol; a real Prometheus deployment wants a plain HTTP GET.  This is
+the thinnest possible adapter: a ThreadingHTTPServer on its own daemon
+thread serving
+
+    GET /metrics   the render callback's text exposition
+                   (`ccs serve` renders its process registry; `ccs
+                   router` renders the FEDERATED fleet exposition, so
+                   one scrape target sees every replica)
+    GET /healthz   200 "ok" -- a liveness probe that costs no scrape
+
+No dependencies, no TLS (the multi-tenant edge is ROADMAP item 4); bind
+it to loopback or a private interface.  Render errors return 500 with
+the error text rather than killing the serving thread.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Callable
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # set per-server via functools.partial-style subclassing in
+    # start_metrics_http; annotated here for clarity
+    render: Callable[[], str]
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.split("?", 1)[0] == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        elif self.path.split("?", 1)[0] == "/metrics":
+            try:
+                body = type(self).render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+            except Exception as e:  # noqa: BLE001 -- a render error must
+                # answer 500, never kill the scrape thread
+                body = f"metrics render failed: {e!r}\n".encode()
+                self.send_response(500)
+                self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes are not log traffic
+        pass
+
+
+def start_metrics_http(render: Callable[[], str], host: str = "127.0.0.1",
+                       port: int = 0):
+    """Serve `render()` on GET /metrics in a daemon thread; returns the
+    started server (``.server_port`` carries the bound port for port=0,
+    ``.shutdown()`` stops it)."""
+    handler = type("MetricsHandler", (_Handler,),
+                   {"render": staticmethod(render)})
+    server = http.server.ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name=f"ccs-metrics-http-{server.server_port}").start()
+    return server

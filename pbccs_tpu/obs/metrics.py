@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import re
 import threading
 from typing import Iterable, Mapping
 
@@ -190,28 +191,76 @@ class MeasurementScope:
 
 
 class MetricsRegistry:
-    """Get-or-create instrument registry with Prometheus exposition."""
+    """Get-or-create instrument registry with Prometheus exposition.
 
-    def __init__(self):
+    ``max_series_per_name`` caps how many distinct label sets one metric
+    name may register (default generous).  Per-replica / per-peer labels
+    are minted from NETWORK identity (replica addresses, session peers),
+    so a hostile or flapping fleet could otherwise grow the registry --
+    and every scrape -- without bound.  Past the cap a NEW label set gets
+    a detached instrument (updates work, nothing is recorded) and the
+    drop is counted under ``ccs_metrics_series_dropped_total{metric}``
+    instead of growing the exposition."""
+
+    def __init__(self, max_series_per_name: int = 512):
         self._lock = threading.Lock()
         self._metrics: dict[MetricKey, Counter | Gauge | Histogram] = {}
         self._help: dict[str, str] = {}
+        self._series_count: dict[str, int] = {}
+        # label sets dropped by the cap, each holding ONE cached
+        # detached instrument: the drop is counted once per label set,
+        # and repeat lookups get the same (unrecorded) handle instead of
+        # a fresh allocation per update on a by-definition hot path
+        self._dropped: dict[MetricKey, Counter | Gauge | Histogram] = {}
+        self._max_series = max_series_per_name
+
+    def set_series_cap(self, max_series_per_name: int) -> None:
+        """Adjust the per-name series cap (applies to NEW label sets)."""
+        if max_series_per_name < 1:
+            raise ValueError("max_series_per_name must be >= 1")
+        with self._lock:
+            self._max_series = max_series_per_name
 
     # ------------------------------------------------------------ creation
+
+    _DROPPED = "ccs_metrics_series_dropped_total"
 
     def _get(self, cls, name: str, help: str | None, labels: dict,
              **kwargs):
         key = (name, _label_key(labels))
+        dropped = new_drop = False
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
-                m = cls(name, key[1], **kwargs)
-                self._metrics[key] = m
+                prior = self._dropped.get(key)
+                if prior is not None:
+                    if not isinstance(prior, cls):
+                        raise TypeError(f"{name} already registered as "
+                                        f"{type(prior).__name__}")
+                    dropped, m = True, prior
+                # the drop counter itself is exempt (its `metric` label
+                # values are existing capped names, already bounded)
+                elif name != self._DROPPED and \
+                        self._series_count.get(name, 0) >= self._max_series:
+                    # cardinality armor: the caller gets a working but
+                    # DETACHED instrument (updates land nowhere), cached
+                    # so the drop counts ONCE per label set
+                    dropped = new_drop = True
+                    m = self._dropped[key] = cls(name, key[1], **kwargs)
+                else:
+                    m = cls(name, key[1], **kwargs)
+                    self._metrics[key] = m
+                    self._series_count[name] = \
+                        self._series_count.get(name, 0) + 1
             elif not isinstance(m, cls):
                 raise TypeError(f"{name} already registered as "
                                 f"{type(m).__name__}")
-            if help:
+            if help and not dropped:
                 self._help.setdefault(name, help)
+        if new_drop:
+            self.counter("ccs_metrics_series_dropped_total",
+                         "New label sets dropped by the per-name series "
+                         "cap", metric=name).inc()
         return m
 
     def counter(self, name: str, help: str | None = None,
@@ -327,6 +376,100 @@ class MetricsRegistry:
 
 def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# ------------------------------------------------------------- federation
+#
+# Text-level helpers for the router's fleet-wide scrape surface: each
+# replica's exposition is relabeled under `replica="host:port"` and the
+# bodies merged into ONE valid exposition (HELP/TYPE once per metric,
+# sample lines grouped by name) so a single Prometheus target sees the
+# whole fleet.  Text-level on purpose -- the router must not need the
+# replica's registry objects, only its `metrics` verb reply.
+
+_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{([^}]*)\})?\s+(.+)$")
+
+
+def relabel_exposition(text: str, **labels: str) -> str:
+    """Inject `labels` into every sample line of a Prometheus text
+    exposition (comment lines pass through)."""
+    extra = ",".join(f'{k}="{_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    if not extra:
+        return text
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            out.append(line)      # not a sample line: pass through
+            continue
+        name, _, inner, value = m.groups()
+        inner = f"{inner},{extra}" if inner else extra
+        out.append(f"{name}{{{inner}}} {value}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merge_expositions(parts: "Iterable[str]") -> str:
+    """Merge several Prometheus text expositions into one: samples are
+    grouped under their base metric name (histogram _bucket/_sum/_count
+    lines group with their parent), HELP/TYPE emitted once per name
+    (first writer wins)."""
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+
+    def base_name(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix):
+                return sample_name[: -len(suffix)]
+        return sample_name
+
+    for part in parts:
+        for line in part.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                name = line.split(None, 3)[2]
+                helps.setdefault(name, line)
+            elif line.startswith("# TYPE "):
+                name = line.split(None, 3)[2]
+                types.setdefault(name, line)
+            elif line.startswith("#"):
+                continue
+            else:
+                m = _SAMPLE_RE.match(line)
+                name = base_name(m.group(1)) if m else line.split(" ")[0]
+                samples.setdefault(name, []).append(line)
+    lines: list[str] = []
+    for name in sorted(samples):
+        if name in helps:
+            lines.append(helps[name])
+        if name in types:
+            lines.append(types[name])
+        lines.extend(samples[name])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def histogram_quantile(counts: "tuple[int, ...]",
+                       bounds: "tuple[float, ...]", q: float) -> float:
+    """Approximate quantile from per-bucket counts (the snapshot()
+    layout: len(bounds)+1 buckets, last = +Inf overflow).  Returns the
+    upper bound of the bucket holding the q-th observation (+Inf bucket
+    reports the last finite bound -- a floor, honestly labeled by the
+    caller); NaN when empty.  Used for the status verb's SLO block."""
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
 
 
 def _fmt(v: float) -> str:

@@ -60,6 +60,7 @@ from pbccs_tpu.ops.mutation_score import (
     edge_read_scores_fast,
     make_patches_fast,
 )
+from pbccs_tpu.obs import flight as obs_flight
 from pbccs_tpu.obs import trace as obs_trace
 from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
@@ -638,6 +639,9 @@ class BatchPolisher:
         self._stats_host = None  # lazily fetched AddRead statistics
         self._cont = _Continuation()
         self._host_tables = pb.host_tables
+        # flight-recorder batch tag: first ZMW id + batch size names the
+        # batch compactly in postmortem dumps
+        self._flight_tag = f"{self.ids[0]}+{self.n_zmws}"
         self._setup(first=True)
 
     # --------------------------------------------------- AddRead statistics
@@ -1306,6 +1310,19 @@ class BatchPolisher:
                                 iterations=int(iters_h[z]))
                    for z in range(self.n_zmws)]
 
+        # flight recorder: the device-resident loop is one jitted program
+        # (per-round host callbacks would reintroduce the fetch-per-round
+        # chain), so its per-round occupancy is RECONSTRUCTED from the
+        # fetched iteration counts -- a ZMW with k iterations was live in
+        # rounds 0..k-1, which is exact for the lockstep loop
+        it0_rounds = opts.max_iterations - budget
+        iters_live = iters_h[: self.n_zmws]
+        for rnd in range(int(iters_live.max(initial=0))):
+            obs_flight.record_round(
+                self._flight_tag, it0_rounds + rnd,
+                int((iters_live > rnd).sum()), self.n_zmws, self._Z,
+                source="device")
+
         # Straggler continuation: the loop exits early once few ZMWs remain
         # (full-width lockstep rounds for 1-2 cycling ZMWs would dominate,
         # e.g. a 40-round budget); finish them in a compact small-Z
@@ -1454,8 +1471,10 @@ class BatchPolisher:
                         self.tpls[z], favorable[z], opts.mutation_neighborhood))
             if all(done):
                 break
-            with obs_trace.span("polish.round", round=it,
-                                live=int((~done).sum())):
+            live = int((~done).sum())
+            obs_flight.record_round(self._flight_tag, it, live,
+                                    self.n_zmws, self._Z)
+            with obs_trace.span("polish.round", round=it, live=live):
                 scores = self.score_mutation_arrays(arrs)
 
                 best_per_zmw: list[list[mutlib.Mutation]] = []

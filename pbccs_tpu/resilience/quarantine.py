@@ -81,10 +81,14 @@ def degrade_to_draft(prep, settings):
 def quarantine_outcome(prep, settings, exc: BaseException):
     """The terminal outcome for a ZMW whose batch AND serial polishes
     failed: draft-only degradation when enabled, else Failure.OTHER."""
+    from pbccs_tpu.obs import flight
     from pbccs_tpu.pipeline import Failure
 
     _m_quarantined.inc()
     log = Logger.default()
+    # postmortem: what the refine loops were doing just before this ZMW
+    # went terminal (the flight recorder's reason-to-exist moment)
+    flight.dump("quarantine", log)
     if getattr(settings, "degrade_quarantined", False):
         try:
             outcome = degrade_to_draft(prep, settings)

@@ -27,13 +27,27 @@ arithmetic), merely reordered in time.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Iterable, Iterator
 
 from pbccs_tpu import pipeline
 from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.runtime.logging import Logger
 from pbccs_tpu.sched.pool import DevicePool
+
+# offline-driver analogue of the serve engine's per-request stage
+# histograms: per BATCH intervals through the prepare pool and the
+# device fleet, so a fleet bench's latency story decomposes the same
+# way a serve trace does (prepare / dispatch wait / polish)
+_reg = default_registry()
+_m_stages = {stage: _reg.histogram(
+    "ccs_sched_stage_latency_seconds",
+    "Per-batch stage intervals through the scheduled pipeline "
+    "(prepare, dispatch wait, polish)",
+    buckets=log_buckets(1e-4, 600.0), stage=stage)
+    for stage in ("prepare", "dispatch", "polish")}
 
 
 class ScheduledPipeline:
@@ -118,6 +132,7 @@ class ScheduledPipeline:
 
         def prep_one(seq: int, idx: int, chunks, precomputed) -> None:
             lease = None
+            t_prep0 = time.monotonic()
             try:
                 if precomputed is not None:
                     finish(seq, (idx, precomputed))
@@ -162,6 +177,8 @@ class ScheduledPipeline:
                 settings, on_error = self.settings, self.on_error
                 fleet = self.pool.n_devices > 1
                 attempts = [0]
+                t_submit = time.monotonic()
+                _m_stages["prepare"].observe(max(t_submit - t_prep0, 0.0))
 
                 def polish(_device):
                     # first attempt on a fleet: let a device-shaped
@@ -173,11 +190,20 @@ class ScheduledPipeline:
                     # failure that followed the batch across devices is
                     # task-shaped: poison input, not hardware).
                     attempts[0] += 1
-                    with obs_trace.span("polish", zmws=len(preps)):
-                        return pipeline.polish_prepared_batch(
-                            preps, settings, on_error=on_error,
-                            raise_device_shaped=fleet and attempts[0] == 1,
-                            prebaked=prebaked)
+                    t_polish0 = time.monotonic()
+                    if attempts[0] == 1:
+                        _m_stages["dispatch"].observe(
+                            max(t_polish0 - t_submit, 0.0))
+                    try:
+                        with obs_trace.span("polish", zmws=len(preps)):
+                            return pipeline.polish_prepared_batch(
+                                preps, settings, on_error=on_error,
+                                raise_device_shaped=fleet
+                                and attempts[0] == 1,
+                                prebaked=prebaked)
+                    finally:
+                        _m_stages["polish"].observe(
+                            max(time.monotonic() - t_polish0, 0.0))
 
                 from pbccs_tpu.resilience import resources
 

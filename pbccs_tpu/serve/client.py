@@ -203,19 +203,33 @@ class CcsClient:
     # ------------------------------------------------------------- verbs
 
     def submit_wire(self, zmw: dict[str, Any],
-                    deadline_ms: float | None = None) -> PendingReply:
-        """Submit an already-wire-shaped ZMW dict."""
+                    deadline_ms: float | None = None,
+                    trace: dict[str, Any] | None = None) -> PendingReply:
+        """Submit an already-wire-shaped ZMW dict.  `trace` attaches a
+        distributed-trace context ({"trace_id", "span_id"}) to the
+        frame; when omitted and a span capture is live on THIS process,
+        the calling thread's innermost span's context is attached
+        automatically, so a traced load generator's requests carry their
+        trace across the wire with no per-call plumbing."""
+        if trace is None:
+            from pbccs_tpu.obs import trace as obs_trace
+
+            trace = obs_trace.current_context()
         handle = PendingReply(self._next_id())
         msg: dict[str, Any] = {"verb": protocol.VERB_SUBMIT,
                                "id": handle.request_id, "zmw": zmw}
         if deadline_ms is not None:
             msg["deadline_ms"] = deadline_ms
+        if trace is not None:
+            msg[protocol.FIELD_TRACE] = trace
         self._send(msg, handle)
         return handle
 
     def submit_chunk(self, chunk: Chunk,
-                     deadline_ms: float | None = None) -> PendingReply:
-        return self.submit_wire(protocol.chunk_to_wire(chunk), deadline_ms)
+                     deadline_ms: float | None = None,
+                     trace: dict[str, Any] | None = None) -> PendingReply:
+        return self.submit_wire(protocol.chunk_to_wire(chunk), deadline_ms,
+                                trace)
 
     def submit(self, zmw_id: str, reads: Sequence[str],
                snr: Sequence[float] | None = None,
@@ -229,7 +243,8 @@ class CcsClient:
     def submit_with_retry(self, zmw: Chunk | dict[str, Any],
                           deadline_ms: float | None = None,
                           policy: "RetryPolicy | None" = None,
-                          reply_timeout: float | None = 600.0
+                          reply_timeout: float | None = 600.0,
+                          trace: dict[str, Any] | None = None
                           ) -> dict[str, Any]:
         """Submit one ZMW, riding out `overloaded` backpressure AND
         connection loss: an overloaded rejection re-submits with
@@ -248,7 +263,10 @@ class CcsClient:
 
         def attempt() -> dict[str, Any]:
             self._ensure_connected()
-            handle = self.submit_wire(wire, deadline_ms)
+            # the retry attempt reuses the SAME trace context: a
+            # resubmitted payload is the same logical request, and one
+            # trace_id must tell its whole retry story
+            handle = self.submit_wire(wire, deadline_ms, trace)
             try:
                 return handle.reply(reply_timeout)
             finally:

@@ -72,6 +72,25 @@ _m_inflight_zmws = _reg.gauge("ccs_serve_in_flight_zmws",
 _m_latency = _reg.histogram("ccs_serve_request_latency_seconds",
                             "Admission-to-completion request latency (s)",
                             buckets=log_buckets(1e-3, 300.0))
+# SLO plane: per-request stage intervals (the latency story decomposed:
+# admission wait -> prepare -> batcher queue -> dispatch wait -> polish
+# -> emit) and the --sloP99Ms burn-rate counters.  Stage handles are
+# pre-created (hot path holds direct references).
+_STAGE_BUCKETS = log_buckets(1e-4, 300.0)
+_m_stages = {stage: _reg.histogram(
+    "ccs_serve_stage_latency_seconds",
+    "Per-request stage intervals (admission wait, prepare, batcher "
+    "queue, dispatch wait, polish, emit)",
+    buckets=_STAGE_BUCKETS, stage=stage)
+    for stage in ("admission", "prepare", "queue", "dispatch", "polish",
+                  "emit")}
+_m_slo_requests = _reg.counter(
+    "ccs_slo_requests_total",
+    "Requests measured against the --sloP99Ms latency objective")
+_m_slo_violations = _reg.counter(
+    "ccs_slo_violations_total",
+    "Requests whose admission-to-completion latency exceeded --sloP99Ms "
+    "(burn-rate numerator; ccs_slo_requests_total is the denominator)")
 
 
 def _flush_shapes(preps: Sequence[PreparedZmw]) -> tuple[int, int, int]:
@@ -152,6 +171,11 @@ class ServeConfig:
     # reap sessions with nothing in flight that send no byte for this
     # long (slow-loris defense); 0 disables
     idle_timeout_s: float = 600.0
+    # ---- SLO plane ----
+    # per-request latency objective in ms (--sloP99Ms): requests slower
+    # than this count into ccs_slo_violations_total (burn-rate
+    # numerator) and the status verb's `slo` block.  0 disables.
+    slo_p99_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -163,11 +187,21 @@ class Request:
     submit_t: float                  # monotonic admission time
     deadline_t: float                # monotonic absolute deadline
     callback: Callable[["Request"], None] | None = None
+    # inbound cross-process trace context ({"trace_id", "span_id"}, the
+    # protocol's `trace` submit field): engine spans parent under it
+    trace_ctx: dict | None = None
     # outcome (exactly one of failure or error set at completion)
     failure: Failure | None = None
     result: ConsensusResult | None = None
     error: str | None = None
     latency_ms: float = 0.0
+    # stage timestamps (monotonic; 0.0 = stage never reached) feeding
+    # the ccs_serve_stage_latency_seconds histograms at completion
+    t_prep0: float = 0.0
+    t_prep1: float = 0.0
+    t_dispatch: float = 0.0
+    t_polish0: float = 0.0
+    t_polish1: float = 0.0
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -391,9 +425,12 @@ class CcsEngine:
     # ------------------------------------------------------------- admission
 
     def submit(self, chunk: Chunk, deadline_ms: float | None = None,
-               callback: Callable[[Request], None] | None = None) -> Request:
+               callback: Callable[[Request], None] | None = None,
+               trace_ctx: dict | None = None) -> Request:
         """Admit one ZMW; returns its Request handle (completes via
-        callback and/or .wait()).  Raises EngineOverloaded when max_pending
+        callback and/or .wait()).  `trace_ctx` is the request's inbound
+        cross-process trace context (protocol `trace` field); engine
+        spans parent under it.  Raises EngineOverloaded when max_pending
         requests are in the system and EngineClosed after close()."""
         now = time.monotonic()
         deadline_ms = (self.config.default_deadline_ms
@@ -414,7 +451,7 @@ class CcsEngine:
             self._seq += 1
             req = Request(seq=self._seq, chunk=chunk, submit_t=now,
                           deadline_t=now + deadline_ms / 1e3,
-                          callback=callback)
+                          callback=callback, trace_ctx=trace_ctx)
         self._prep_queue.put(req)
         return req
 
@@ -436,13 +473,16 @@ class CcsEngine:
                     if r.read_accuracy >= self.config.min_read_score]
             if len(kept) != len(req.chunk.reads):
                 req.chunk = Chunk(req.chunk.id, kept, req.chunk.snr)
+            req.t_prep0 = time.monotonic()
             try:
-                with obs_trace.span("serve.prep", zmw=req.chunk.id), \
+                with obs_trace.span("serve.prep", ctx=req.trace_ctx,
+                                    zmw=req.chunk.id), \
                         timing.stage("serve.prep"):
                     failure, prep = self._prep_fn(req.chunk, self.settings)
             except Exception as e:  # noqa: BLE001 -- isolate the request
                 self._complete_error(req, f"prep failed: {e!r}")
                 continue
+            req.t_prep1 = time.monotonic()
             if failure is not None:
                 self._complete(req, failure, None)
                 continue
@@ -514,6 +554,11 @@ class CcsEngine:
         parts = [batch]
         if cap is not None and len(batch.items) > cap:
             resources.note_presplit()
+            # capacity-split postmortem: what the refine loops were doing
+            # just before the governor had to intervene
+            from pbccs_tpu.obs import flight
+
+            flight.dump("capacity-split", self._log)
             self._log.info(
                 f"flush bucket={batch.key}: governor ceiling {cap} "
                 f"splits {len(batch.items)} ZMW(s) into "
@@ -529,6 +574,9 @@ class CcsEngine:
             self._dispatch_part(part, bucket)
 
     def _dispatch_part(self, batch: Batch, capacity_bucket) -> None:
+        now = time.monotonic()
+        for item in batch.items:
+            item.payload[0].t_dispatch = now
         with self._lock:
             self._in_flight_batches += 1
             self._in_flight_zmws += len(batch.items)
@@ -566,43 +614,65 @@ class CcsEngine:
         quarantining in place, so the pool can bench the sick device and
         requeue the whole batch to a healthy one -- mirroring the batch
         executor (pbccs_tpu.sched.executor)."""
-        from pbccs_tpu.resilience.watchdog import (WatchdogTimeout,
-                                                   run_with_deadline)
-
         raise_dev = (first_attempt and self._pool is not None
                      and self._pool.n_devices > 1
                      and self._polish_fn is _polish_shape_pinned)
         preps = [item.payload[1] for item in batch.items]
-        with obs_trace.span("serve.polish", bucket=str(batch.key),
-                            zmws=len(batch.items),
-                            reason=batch.reason), \
-                timing.stage("serve.polish"):
-            # the watchdog turns a hung device program into a structured
-            # timeout on THIS batch's requests; the engine keeps serving
-            try:
-                outcomes = run_with_deadline(
-                    (lambda: self._polish_fn(preps, self.settings,
-                                             raise_device_shaped=True))
-                    if raise_dev else
-                    (lambda: self._polish_fn(preps, self.settings)),
-                    self.config.polish_timeout_ms / 1e3,
-                    site="serve.polish")
-            except WatchdogTimeout as e:
-                if not first_attempt and self._pool is not None:
-                    # a SECOND expiry on a different device is workload-
-                    # shaped (the batch is just slower than the deadline,
-                    # e.g. a cold compile), not sick hardware: wrap it so
-                    # the pool fails the batch instead of striking another
-                    # healthy device and touring the whole fleet at one
-                    # full timeout per hop
-                    raise RuntimeError(
-                        f"polish timed out on two devices: {e}") from e
-                raise
+        reqs = [item.payload[0] for item in batch.items]
+        # batch-level span: parents under the FIRST traced request's
+        # context; every member trace id rides in args so the fleet
+        # merge can associate the shared device work with each request
+        ctx = next((r.trace_ctx for r in reqs if r.trace_ctx), None)
+        trace_ids = sorted({r.trace_ctx["trace_id"] for r in reqs
+                            if r.trace_ctx})[:32]
+        t_polish0 = time.monotonic()
+        for req in reqs:
+            req.t_polish0 = t_polish0
+        try:
+            with obs_trace.span("serve.polish", ctx=ctx,
+                                bucket=str(batch.key),
+                                zmws=len(batch.items),
+                                reason=batch.reason,
+                                trace_ids=trace_ids), \
+                    timing.stage("serve.polish"):
+                outcomes = self._run_polish_inner(preps, raise_dev,
+                                                  first_attempt)
+        finally:
+            t_polish1 = time.monotonic()
+            for req in reqs:
+                req.t_polish1 = t_polish1
         if len(outcomes) != len(batch.items):
             raise RuntimeError(
                 f"polish returned {len(outcomes)} outcomes for "
                 f"{len(batch.items)} requests")
         return outcomes
+
+    def _run_polish_inner(self, preps, raise_dev: bool,
+                          first_attempt: bool) -> list:
+        from pbccs_tpu.resilience.watchdog import (WatchdogTimeout,
+                                                   run_with_deadline)
+
+        # the watchdog turns a hung device program into a structured
+        # timeout on THIS batch's requests; the engine keeps serving
+        try:
+            return run_with_deadline(
+                (lambda: self._polish_fn(preps, self.settings,
+                                         raise_device_shaped=True))
+                if raise_dev else
+                (lambda: self._polish_fn(preps, self.settings)),
+                self.config.polish_timeout_ms / 1e3,
+                site="serve.polish")
+        except WatchdogTimeout as e:
+            if not first_attempt and self._pool is not None:
+                # a SECOND expiry on a different device is workload-
+                # shaped (the batch is just slower than the deadline,
+                # e.g. a cold compile), not sick hardware: wrap it so
+                # the pool fails the batch instead of striking another
+                # healthy device and touring the whole fleet at one
+                # full timeout per hop
+                raise RuntimeError(
+                    f"polish timed out on two devices: {e}") from e
+            raise
 
     def _complete_batch(self, batch: Batch, outcomes: list | None = None,
                         error: BaseException | None = None) -> None:
@@ -668,8 +738,24 @@ class CcsEngine:
 
     # ------------------------------------------------------------ completion
 
+    @staticmethod
+    def _observe_stages(req: Request, now: float) -> None:
+        """Per-request stage intervals into the SLO histograms.  Stages a
+        request never reached (early failure, prep-side yield gate) are
+        skipped, not recorded as zero; clock jitter is clamped at 0."""
+        marks = (("admission", req.submit_t, req.t_prep0),
+                 ("prepare", req.t_prep0, req.t_prep1),
+                 ("queue", req.t_prep1, req.t_dispatch),
+                 ("dispatch", req.t_dispatch, req.t_polish0),
+                 ("polish", req.t_polish0, req.t_polish1),
+                 ("emit", req.t_polish1, now))
+        for stage, t0, t1 in marks:
+            if t0 > 0.0 and t1 > 0.0:
+                _m_stages[stage].observe(max(t1 - t0, 0.0))
+
     def _finish(self, req: Request) -> None:
-        req.latency_ms = (time.monotonic() - req.submit_t) * 1e3
+        now = time.monotonic()
+        req.latency_ms = (now - req.submit_t) * 1e3
         with self._lock:
             self._pending -= 1
             self._completed += 1
@@ -680,6 +766,11 @@ class CcsEngine:
         if req.error is not None:
             _m_errors.inc()
         _m_latency.observe(req.latency_ms / 1e3)
+        self._observe_stages(req, now)
+        if self.config.slo_p99_ms > 0:
+            _m_slo_requests.inc()
+            if req.latency_ms > self.config.slo_p99_ms:
+                _m_slo_violations.inc()
         req.done.set()
         if req.callback is not None:
             try:
@@ -726,6 +817,7 @@ class CcsEngine:
         return {
             "engine": "ccs-serve",
             **sched,
+            "slo": self._slo_block(),
             "uptime_s": round(time.monotonic() - self._start_t, 3),
             "queue_depth": max(0, snap["pending"] - snap["in_flight_zmws"]),
             "bucketed": self._batcher.pending_count(),
@@ -739,6 +831,29 @@ class CcsEngine:
             "device_fetches": timing.fetch_count(self._window),
             "metrics": self.metrics_snapshot(),
             **snap,
+        }
+
+    def _slo_block(self) -> dict:
+        """The status verb's SLO summary: the burn-rate pair plus an
+        observed-p99 estimate from the latency histogram (bucket upper
+        bound -- honest to within the log-bucket resolution)."""
+        import math
+
+        from pbccs_tpu.obs.metrics import histogram_quantile
+
+        counts, _s, n = _m_latency.snapshot()
+        p99 = histogram_quantile(counts, _m_latency.bounds, 0.99)
+        requests = _m_slo_requests.value
+        violations = _m_slo_violations.value
+        return {
+            "target_p99_ms": self.config.slo_p99_ms,
+            "enabled": self.config.slo_p99_ms > 0,
+            "requests": int(requests),
+            "violations": int(violations),
+            "violation_rate": round(violations / requests, 6)
+            if requests else 0.0,
+            "observed_p99_ms_le": round(p99 * 1e3, 3)
+            if n and math.isfinite(p99) else None,
         }
 
     def metrics_text(self) -> str:
@@ -755,7 +870,9 @@ class CcsEngine:
             if kind == "histogram" or not name.startswith(
                     ("ccs_serve_", "ccs_batch_", "ccs_device_",
                      "ccs_retries_", "ccs_quarantine", "ccs_degraded_",
-                     "ccs_watchdog_", "ccs_faults_", "ccs_sched_")):
+                     "ccs_watchdog_", "ccs_faults_", "ccs_sched_",
+                     "ccs_slo_", "ccs_refine_", "ccs_flight_",
+                     "ccs_metrics_")):
                 continue
             suffix = "{%s}" % ",".join(
                 f"{k}={v}" for k, v in labels) if labels else ""

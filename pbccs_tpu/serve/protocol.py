@@ -16,11 +16,26 @@ back before emission.  The router id is the failover/dedup key: after a
 replica failure the same `q<N>` may be resubmitted to another replica,
 and the first reply bearing it wins (later duplicates are dropped), so
 a client sees exactly one reply per id it sent.  Ids beginning `hc` on
-a replica link are the router's own status-verb health probes.  All of
-this is invisible at both edges; no wire shape changes.
+a replica link are the router's own status-verb health probes, and ids
+beginning `fl` its fleet-introspection calls (metrics federation, trace
+fan-out).  All of this is invisible at both edges; no wire shape
+changes.
+
+Trace context (the fleet observability plane): a submit frame MAY carry
+a `trace` object -- {"trace_id": <hex string>, "span_id": <string>} --
+naming the distributed trace the request belongs to and the sender-side
+span it continues.  Each tier propagates it inward (client -> router ->
+replica session -> engine prep/polish spans -> sched dispatch) and the
+router REWRITES span_id on the replica hop to its own per-request span,
+exactly as it rewrites the request id; trace_id is never rewritten, so
+one id names the request across every process.  The field is pure
+observability: it changes no consensus, no routing, no admission.  A
+malformed `trace` object is rejected `bad_request` like any other
+malformed field (the armor validates everything it forwards).
 
 Client verbs:
-  submit  {"verb": "submit", "id": ..., "zmw": <zmw>, "deadline_ms": ...}
+  submit  {"verb": "submit", "id": ..., "zmw": <zmw>, "deadline_ms": ...,
+           "trace": {"trace_id": ..., "span_id": ...}}   # trace optional
   status  {"verb": "status", "id": ...}
   metrics {"verb": "metrics", "id": ...}
   trace   {"verb": "trace", "id": ..., "action": "start" | "stop"}
@@ -103,6 +118,12 @@ ERR_OVERLOADED = "overloaded"
 ERR_CLOSED = "closed"
 ERR_INTERNAL = "internal"
 
+# optional wire fields (cross-cutting objects that may ride a verb frame)
+FIELD_TRACE = "trace"
+# the trace-context object's keys
+KEY_TRACE_ID = "trace_id"
+KEY_SPAN_ID = "span_id"
+
 
 # ------------------------------------------------------------------ wire spec
 #
@@ -146,6 +167,16 @@ WIRE_UNSOLICITED = (TYPE_CLOSED,)
 
 WIRE_ERRORS = (ERR_BAD_REQUEST, ERR_OVERLOADED, ERR_CLOSED, ERR_INTERNAL)
 
+# optional cross-cutting wire FIELDS: {field: {"keys": (...), "verbs":
+# (carrier verbs...)}}.  protolint's PRO001 checks the FIELD_*/KEY_*
+# constants against this table both ways (the same membership rule as
+# verbs/replies/errors), so the trace-context contract cannot drift
+# from the names the code ships.
+WIRE_FIELDS = {
+    FIELD_TRACE: {"keys": (KEY_TRACE_ID, KEY_SPAN_ID),
+                  "verbs": (VERB_SUBMIT,)},
+}
+
 
 class ProtocolError(ValueError):
     """A message violates the wire contract (bad JSON, wrong field types,
@@ -172,6 +203,37 @@ def decode_line(line: bytes | str) -> dict[str, Any]:
     if not isinstance(msg, dict):
         raise ProtocolError("frame is not a JSON object")
     return msg
+
+
+# ---------------------------------------------------------------- trace wire
+
+# armor bound: trace ids/span ids are opaque strings, but the session
+# must not carry arbitrarily large attacker-chosen payloads into every
+# span/export downstream
+_TRACE_VALUE_MAX = 128
+
+
+def trace_from_wire(obj: Any) -> dict[str, Any] | None:
+    """Validate + normalize a frame's optional `trace` field.  Returns
+    {"trace_id": str, "span_id": str | None}, or None when absent;
+    raises ProtocolError (-> bad_request) on malformed input."""
+    if obj is None:
+        return None
+    if not isinstance(obj, dict):
+        raise ProtocolError("trace must be an object")
+    trace_id = obj.get(KEY_TRACE_ID)
+    if not isinstance(trace_id, str) or not trace_id \
+            or len(trace_id) > _TRACE_VALUE_MAX:
+        raise ProtocolError(
+            f"trace.{KEY_TRACE_ID} must be a non-empty string "
+            f"(<= {_TRACE_VALUE_MAX} chars)")
+    span_id = obj.get(KEY_SPAN_ID)
+    if span_id is not None and (not isinstance(span_id, str)
+                                or len(span_id) > _TRACE_VALUE_MAX):
+        raise ProtocolError(
+            f"trace.{KEY_SPAN_ID} must be a string "
+            f"(<= {_TRACE_VALUE_MAX} chars)")
+    return {KEY_TRACE_ID: trace_id, KEY_SPAN_ID: span_id}
 
 
 # ------------------------------------------------------------------ ZMW wire

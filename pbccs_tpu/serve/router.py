@@ -64,7 +64,12 @@ import threading
 import time
 from typing import Any, Callable
 
-from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.obs import trace as obs_trace
+from pbccs_tpu.obs.metrics import (
+    default_registry,
+    merge_expositions,
+    relabel_exposition,
+)
 from pbccs_tpu.runtime.logging import Logger, LogLevel
 from pbccs_tpu.sched.health import HealthPolicy, HealthTracker, StickyMap
 from pbccs_tpu.serve import protocol
@@ -137,10 +142,11 @@ class RoutedRequest:
     once (guarded by the router lock via `done`)."""
 
     __slots__ = ("rid", "key", "wire", "deadline_ms", "emit", "attempted",
-                 "assigned", "done", "submit_t")
+                 "assigned", "done", "submit_t", "trace")
 
     def __init__(self, rid: str, key, wire: dict, deadline_ms,
-                 emit: Callable[[dict], None]):
+                 emit: Callable[[dict], None],
+                 trace: dict | None = None):
         self.rid = rid
         self.key = key
         self.wire = wire
@@ -150,6 +156,15 @@ class RoutedRequest:
         self.assigned: str | None = None
         self.done = False
         self.submit_t = time.monotonic()
+        # inbound trace context (client-sent or edge-minted): trace_id is
+        # NEVER rewritten; the replica hop carries it with span_id
+        # rewritten to this request's router span (`rt-<rid>`), exactly
+        # as the request id itself is rewritten
+        self.trace = trace
+
+    def span_id(self) -> str:
+        """The router-side span id the replica hop parents under."""
+        return f"rt-{self.rid}"
 
 
 class ReplicaLink:
@@ -309,6 +324,15 @@ class CcsRouter:
         self._requests: dict[str, RoutedRequest] = {}
         self._seq = 0
         self._probe_seq = 0
+        # fleet-call plumbing (trace fan-out, metrics federation): ids
+        # `fl<N>` on replica links complete these waiters, never the
+        # request path
+        self._fleet_seq = 0
+        self._fleet_waits: dict[str, tuple[threading.Event, list]] = {}
+        # router-owned span capture (the `trace` verb); CAS against the
+        # process-wide tracer exactly like the engine's
+        self._trace_lock = threading.Lock()
+        self._capture: obs_trace.Tracer | None = None
         self._accepting = False    # submit gate (drain flips this first)
         self._down = True          # hard stop (failover stops too)
         self._routed_total = 0
@@ -380,6 +404,13 @@ class CcsRouter:
                         f"with {pending} request(s) pending: aborting")
                     break
                 time.sleep(0.01)
+        # stop any live capture while the replica links still exist: the
+        # trace-stop fan-out must reach the replicas or their globally-
+        # installed tracers outlive the router (accumulating spans until
+        # max_spans, and refusing the next router's trace start).  The
+        # dumps themselves are discarded -- a short bound keeps shutdown
+        # from waiting on a sick replica.
+        self.trace_stop(timeout_s=2.0)
         self._stop.set()
         with self._lock:
             health_thread = self._health_thread
@@ -411,6 +442,12 @@ class CcsRouter:
             emit_queue.put(None)   # behind every queued reply
         if emit_thread is not None:
             emit_thread.join(timeout=10.0)
+        # unblock any fleet-call waiters (their links are gone)
+        with self._lock:
+            waits = list(self._fleet_waits.values())
+            self._fleet_waits.clear()
+        for event, _slot in waits:
+            event.set()
         self._log.info("ccs router down")
         return drained
 
@@ -423,16 +460,20 @@ class CcsRouter:
     # ------------------------------------------------------------ submission
 
     def submit_routed(self, wire_zmw: dict, key, deadline_ms,
-                      emit: Callable[[dict], None]) -> RoutedRequest:
+                      emit: Callable[[dict], None],
+                      trace: dict | None = None) -> RoutedRequest:
         """Route one validated wire-shaped ZMW; `emit` receives exactly
         one reply dict (result or structured error; the caller rewrites
-        the id).  Raises RouterClosed after close()."""
+        the id).  `trace` is the request's validated trace context
+        (client-sent, or edge-minted by the session when a capture is
+        live).  Raises RouterClosed after close()."""
         with self._lock:
             if not self._accepting:
                 raise RouterClosed("router is not accepting requests")
             self._seq += 1
             rid = f"q{self._seq}"
-        req = RoutedRequest(rid, key, wire_zmw, deadline_ms, emit)
+        req = RoutedRequest(rid, key, wire_zmw, deadline_ms, emit,
+                            trace=trace)
         self._dispatch(req)
         return req
 
@@ -503,6 +544,15 @@ class CcsRouter:
                                    "id": req.rid, "zmw": req.wire}
             if req.deadline_ms is not None:
                 msg["deadline_ms"] = req.deadline_ms
+            if req.trace is not None:
+                # replica hop: same trace_id, span_id rewritten to the
+                # router's per-request span (the id-rewrite rule applied
+                # to trace context) -- a failover re-dispatch repeats
+                # exactly this frame, so the trace follows the request
+                msg[protocol.FIELD_TRACE] = {
+                    protocol.KEY_TRACE_ID:
+                        req.trace[protocol.KEY_TRACE_ID],
+                    protocol.KEY_SPAN_ID: req.span_id()}
             if link.send(msg):
                 return
             # the link died under us.  If the request is still parked on
@@ -528,6 +578,22 @@ class CcsRouter:
             if not mine:
                 return
 
+    def _record_request_span(self, req: RoutedRequest, msg: dict) -> None:
+        """Retroactive per-request router span (recorded at emission:
+        the one point every request passes exactly once).  Its exported
+        span_id is the `rt-<rid>` the replica hop already named as its
+        remote parent, so the merged fleet trace connects client ->
+        router -> replica under one trace_id."""
+        tracer = obs_trace.get_tracer()
+        if tracer is None:
+            return
+        tracer.add_span(
+            "router.request", time.monotonic() - req.submit_t,
+            ctx=req.trace, span_id=req.span_id(),
+            replica=req.assigned,
+            attempts=len(req.attempted),
+            outcome=msg.get("type"))
+
     def _emit(self, req: RoutedRequest, msg: dict) -> None:
         """Hand a completed reply to the dedicated emission thread.
         Emit callbacks write to CLIENT sockets (blocking, bounded only
@@ -535,6 +601,7 @@ class CcsRouter:
         they would starve that link's health-probe replies behind one
         slow client and falsely bench a healthy replica -- the same
         hand-off the serve engine does for batch completions."""
+        self._record_request_span(req, msg)
         with self._lock:
             q = self._emit_queue
         if q is not None:
@@ -588,6 +655,16 @@ class CcsRouter:
         rid = msg.get("id")
         if isinstance(rid, str) and rid.startswith("hc"):
             self._on_probe_reply(replica, msg)
+            return
+        if isinstance(rid, str) and rid.startswith("fl"):
+            # fleet-call reply (trace fan-out / metrics federation):
+            # complete the waiter, never the request path
+            with self._lock:
+                waiter = self._fleet_waits.pop(rid, None)
+            if waiter is not None:
+                event, slot = waiter
+                slot.append(msg)
+                event.set()
             return
         resubmit = None
         with self._lock:
@@ -809,6 +886,81 @@ class CcsRouter:
             self._log.info(f"router: replica {replica.name} recovered; "
                            "re-admitted to routing")
 
+    # ------------------------------------------------------- fleet calls
+
+    def _fleet_call(self, frame: dict, timeout_s: float = 5.0
+                    ) -> dict[str, dict]:
+        """Send one verb frame to every CONNECTED replica and collect
+        the replies: {replica_name: reply}.  Replies use `fl<N>` ids so
+        the link reader routes them to waiters, never the request path;
+        a replica that cannot answer within the timeout (or whose link
+        died) is simply absent from the result -- fleet introspection
+        must degrade, not block behind a sick replica forever."""
+        waiters: list[tuple[str, _Replica, threading.Event, list]] = []
+        with self._lock:
+            targets = [(r, r.link) for r in self._replicas
+                       if r.link is not None and r.link.alive]
+        for replica, link in targets:
+            with self._lock:
+                self._fleet_seq += 1
+                fid = f"fl{self._fleet_seq}"
+                event: threading.Event = threading.Event()
+                slot: list = []
+                self._fleet_waits[fid] = (event, slot)
+            if not link.send(dict(frame, id=fid)):
+                with self._lock:
+                    self._fleet_waits.pop(fid, None)
+                continue
+            waiters.append((fid, replica, event, slot))
+        out: dict[str, dict] = {}
+        deadline = time.monotonic() + timeout_s
+        for _fid, replica, event, slot in waiters:
+            if event.wait(max(deadline - time.monotonic(), 0.0)) and slot:
+                out[replica.name] = slot[0]
+        # drop THIS call's straggler waiters so the map cannot grow
+        # unbounded (concurrent fleet calls -- an HTTP scrape racing a
+        # trace stop -- own their fids; never touch theirs)
+        with self._lock:
+            for fid, _replica, event, _slot in waiters:
+                if not event.is_set():
+                    self._fleet_waits.pop(fid, None)
+        return out
+
+    # ------------------------------------------------------ trace fan-out
+
+    def trace_start(self) -> bool:
+        """Install a router-side span capture AND fan a trace-start out
+        to every connected replica (the protocol's `trace` verb at the
+        router tier).  Returns False when a capture is already live."""
+        with self._trace_lock:
+            if self._capture is not None:
+                return False
+            cap = obs_trace.Tracer(tag="router")
+            if not obs_trace.install_tracer(cap):
+                return False
+            self._capture = cap
+        self._fleet_call({"verb": protocol.VERB_TRACE, "action": "start"},
+                         timeout_s=5.0)
+        return True
+
+    def trace_stop(self, timeout_s: float = 10.0) -> dict | None:
+        """Stop the capture: collect each replica's span dump (trace
+        verb, action=stop), stop the router's own, and return
+        {"trace": <router chrome>, "replicas": {name: chrome}} -- the
+        inputs tools/trace_merge.py assembles into one fleet timeline.
+        None when no capture was running."""
+        with self._trace_lock:
+            cap, self._capture = self._capture, None
+            if cap is None:
+                return None
+            obs_trace.clear_tracer(cap)
+        replies = self._fleet_call(
+            {"verb": protocol.VERB_TRACE, "action": "stop"},
+            timeout_s=timeout_s)
+        replicas = {name: msg["trace"] for name, msg in replies.items()
+                    if isinstance(msg.get("trace"), dict)}
+        return {"trace": cap.to_chrome(), "replicas": replicas}
+
     # ------------------------------------------- status / metrics (session)
 
     def status(self) -> dict:
@@ -836,7 +988,20 @@ class CcsRouter:
             }
 
     def metrics_text(self) -> str:
-        return _reg.render_prometheus()
+        """FEDERATED fleet exposition: the router's own registry plus
+        every reachable replica's `metrics` verb body relabeled under
+        `replica="host:port"`, merged into one valid exposition -- a
+        single Prometheus target (the router's --metricsPort, or its
+        NDJSON metrics verb) sees the whole fleet.  Unreachable replicas
+        degrade to absence, never to a blocked scrape."""
+        parts = [_reg.render_prometheus()]
+        replies = self._fleet_call({"verb": protocol.VERB_METRICS},
+                                   timeout_s=5.0)
+        for name, msg in sorted(replies.items()):
+            body = msg.get("body")
+            if isinstance(body, str) and body:
+                parts.append(relabel_exposition(body, replica=name))
+        return merge_expositions(parts)
 
 
 class _RouterSession(_FramedSession):
@@ -852,7 +1017,12 @@ class _RouterSession(_FramedSession):
         if parsed is None:
             self._release_slot()
             return
-        chunk, deadline_ms = parsed
+        chunk, deadline_ms, trace_ctx = parsed
+        if trace_ctx is None and obs_trace.get_tracer() is not None:
+            # edge-minted trace id: with a capture live, every request
+            # gets a fleet-wide identity even when the client sent none
+            trace_ctx = {protocol.KEY_TRACE_ID: obs_trace.new_trace_id(),
+                         protocol.KEY_SPAN_ID: None}
 
         def on_reply(reply: dict) -> None:
             self._release_slot()
@@ -866,11 +1036,36 @@ class _RouterSession(_FramedSession):
             # validation accepted
             self.server.engine.submit_routed(
                 protocol.chunk_to_wire(chunk), route_key(chunk),
-                deadline_ms, on_reply)
+                deadline_ms, on_reply, trace=trace_ctx)
         except RouterClosed as e:
             self._release_slot()
             self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
                                              str(e)))
+
+    def _on_trace(self, msg: dict) -> None:
+        """Router-tier trace verb: start/stop fan out to the replica
+        fleet; stop returns the router's own capture plus each
+        replica's under `replicas` (tools/trace_merge.py merges them)."""
+        rid = msg.get("id")
+        action = msg.get("action")
+        if action == "start":
+            started = self.server.engine.trace_start()
+            self.send({"type": protocol.TYPE_TRACE, "id": rid,
+                       "state": "started" if started
+                       else "already_running"})
+        elif action == "stop":
+            bundle = self.server.engine.trace_stop()
+            reply = {"type": protocol.TYPE_TRACE, "id": rid,
+                     "state": "stopped" if bundle is not None
+                     else "not_running"}
+            if bundle is not None:
+                reply["trace"] = bundle["trace"]
+                reply["replicas"] = bundle["replicas"]
+            self.send(reply)
+        else:
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_BAD_REQUEST,
+                'trace.action must be "start" or "stop"'))
 
 
 class RouterServer(CcsServer):
@@ -936,6 +1131,12 @@ def build_router_parser() -> argparse.ArgumentParser:
                    help="On SIGTERM/SIGINT, wait this long for routed "
                         "in-flight requests before failing the rest. "
                         "Default = %(default)s")
+    p.add_argument("--metricsPort", type=int, default=0,
+                   help="Serve the FEDERATED fleet exposition (router + "
+                        "every replica under a replica label) on a "
+                        "stdlib-HTTP /metrics endpoint (-1 = ephemeral, "
+                        "printed as CCS-METRICS-READY; 0 disables). "
+                        "Default = %(default)s")
     p.add_argument("--logLevel", default="INFO")
     return p
 
@@ -963,6 +1164,10 @@ def run_router(argv: list[str] | None = None) -> int:
     with router:
         server = RouterServer(router, args.host, args.port, logger=log)
         server.start()
+        from pbccs_tpu.serve.server import start_metrics_endpoint
+
+        metrics_http = start_metrics_endpoint(
+            args.metricsPort, router.metrics_text, args.host, log)
         # machine-readable ready line for wrappers (mirrors CCS-SERVE-READY)
         print(f"CCS-ROUTER-READY {server.host} {server.port}", flush=True)
 
@@ -988,6 +1193,8 @@ def run_router(argv: list[str] | None = None) -> int:
         server.notify_draining()
         drained = router.close(drain=True, deadline_s=args.drainTimeout)
         server.shutdown()
+        if metrics_http is not None:
+            metrics_http.shutdown()
         log.info("ccs router drained cleanly" if drained
                  else "ccs router drain deadline hit; failed remainder")
     log.flush()

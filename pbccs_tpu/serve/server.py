@@ -147,12 +147,15 @@ class _FramedSession:
             "trace is not supported by this front door"))
 
     def _parse_submit(self, msg: dict):
-        """Shared submit decode: validated chunk + deadline, or None after
-        a structured `bad_request` reply (the caller already released its
-        slot-acquire responsibilities via the returned sentinel)."""
+        """Shared submit decode: validated (chunk, deadline, trace
+        context), or None after a structured `bad_request` reply (the
+        caller already released its slot-acquire responsibilities via
+        the returned sentinel)."""
         rid = msg.get("id")
         try:
             chunk = protocol.chunk_from_wire(msg.get("zmw"))
+            trace_ctx = protocol.trace_from_wire(
+                msg.get(protocol.FIELD_TRACE))
         except protocol.ProtocolError as e:
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, str(e)))
@@ -163,7 +166,7 @@ class _FramedSession:
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, "deadline_ms must be a number"))
             return None
-        return chunk, deadline_ms
+        return chunk, deadline_ms, trace_ctx
 
     def _on_status(self, msg: dict) -> None:
         status = self.server.engine.status()
@@ -276,7 +279,7 @@ class _Session(_FramedSession):
         if parsed is None:
             self._release_slot()
             return
-        chunk, deadline_ms = parsed
+        chunk, deadline_ms, trace_ctx = parsed
 
         def on_done(req: Request) -> None:
             self._release_slot()
@@ -290,7 +293,8 @@ class _Session(_FramedSession):
 
         try:
             self.server.engine.submit(chunk, deadline_ms=deadline_ms,
-                                      callback=on_done)
+                                      callback=on_done,
+                                      trace_ctx=trace_ctx)
         except EngineOverloaded as e:
             self._release_slot()
             self.send(protocol.error_to_wire(
@@ -508,6 +512,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="On SIGTERM/SIGINT, wait this long for in-flight "
                         "requests before fast-aborting the rest. "
                         "Default = %(default)s")
+    # observability plane (obs/): the HTTP scrape surface + SLO target
+    p.add_argument("--metricsPort", type=int, default=0,
+                   help="Serve a stdlib-HTTP Prometheus /metrics scrape "
+                        "endpoint on this port (-1 = ephemeral, printed "
+                        "as CCS-METRICS-READY; 0 disables). "
+                        "Default = %(default)s")
+    p.add_argument("--sloP99Ms", type=float, default=defaults.slo_p99_ms,
+                   help="Per-request latency objective in ms: slower "
+                        "requests count into ccs_slo_violations_total "
+                        "and the status verb's slo block (0 disables). "
+                        "Default = %(default)s")
     p.add_argument("--compileCache", default=None, metavar="DIR",
                    help="Persistent XLA compilation-cache directory "
                         "shared across replicas/restarts: a rolling "
@@ -560,11 +575,14 @@ def run_serve(argv: list[str] | None = None) -> int:
         polish_timeout_ms=(args.polishTimeout or 0) * 1e3,
         max_line_bytes=args.maxLineBytes,
         max_inflight_per_session=args.maxInflightPerSession,
-        idle_timeout_s=args.idleTimeout)
+        idle_timeout_s=args.idleTimeout,
+        slo_p99_ms=args.sloP99Ms)
 
     with CcsEngine(settings, config, logger=log) as engine:
         server = CcsServer(engine, args.host, args.port, logger=log)
         server.start()
+        metrics_http = start_metrics_endpoint(
+            args.metricsPort, engine.metrics_text, args.host, log)
         # machine-readable ready line for wrappers (serve_bench polls it)
         print(f"CCS-SERVE-READY {server.host} {server.port}", flush=True)
 
@@ -594,7 +612,26 @@ def run_serve(argv: list[str] | None = None) -> int:
         server.notify_draining()
         drained = engine.close(drain=True, deadline_s=args.drainTimeout)
         server.shutdown()
+        if metrics_http is not None:
+            metrics_http.shutdown()
         log.info("ccs serve drained cleanly" if drained
                  else "ccs serve drain deadline hit; aborted remainder")
     log.flush()
     return 0
+
+
+def start_metrics_endpoint(port: int, render, host: str, log):
+    """Shared `--metricsPort` wiring for `ccs serve` and `ccs router`:
+    0 disables, -1 binds an ephemeral port; the bound port is printed as
+    a machine-readable CCS-METRICS-READY line (wrappers/smokes poll it,
+    mirroring CCS-SERVE-READY)."""
+    if port == 0:
+        return None
+    from pbccs_tpu.obs.httpexp import start_metrics_http
+
+    server = start_metrics_http(render, host=host,
+                                port=0 if port < 0 else port)
+    print(f"CCS-METRICS-READY {host} {server.server_port}", flush=True)
+    log.info(f"metrics scrape endpoint on "
+             f"http://{host}:{server.server_port}/metrics")
+    return server

@@ -23,3 +23,11 @@ AST_CASES = {
     "PRO003": ("pro003_pos.py", "pro003_neg.py"),
     "ANA002": ("ana002_pos.py", None),   # any parseable file is the neg
 }
+
+# Repo-wide rules whose fixtures need a constructed docs tree (the
+# registry drift checks read DESIGN.md, which a path-scoped run cannot
+# see).  tests/test_analysis.py copies each pair into a mini repo with
+# the matching DESIGN.md table and asserts fire/quiet there.
+REPO_CASES = {
+    "REG010": ("reg010_pos.py", "reg010_neg.py"),
+}

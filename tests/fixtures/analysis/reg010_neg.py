@@ -1,0 +1,14 @@
+"""REG010 negative: every span name recorded here is listed in the
+constructed mini repo's DESIGN.md span table (`reg010.documented`), and
+non-obs `.span(...)` calls (a regex match object's span) never count as
+trace sites."""
+
+import re
+
+from pbccs_tpu.obs import trace as obs_trace
+
+
+def traced_work(tracer):
+    with obs_trace.span("reg010.documented"):
+        m = re.match(r"(a)+", "aaa")
+        return m.span(1)        # regex span, not a trace site

@@ -1,0 +1,10 @@
+"""REG010 positive: records a trace span whose name is missing from the
+DESIGN.md span table (the constructed-repo test copies this file into a
+mini repo whose table does NOT list `reg010.undocumented`)."""
+
+from pbccs_tpu.obs import trace as obs_trace
+
+
+def traced_work():
+    with obs_trace.span("reg010.undocumented", detail=1):
+        return 42

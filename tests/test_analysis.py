@@ -32,6 +32,7 @@ _spec = importlib.util.spec_from_file_location("cases", FIXTURES / "cases.py")
 _cases = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_cases)
 AST_CASES = _cases.AST_CASES
+REPO_CASES = _cases.REPO_CASES
 
 
 def rules_in(name: str) -> set[str]:
@@ -62,7 +63,7 @@ def test_every_ast_rule_has_fixtures():
     constructed = {"REG001", "REG002", "REG003", "REG004", "REG005",
                    "REG006", "REG007", "REG008", "REG009", "PRO001",
                    "ANA001"}
-    missing = set(RULES) - set(AST_CASES) - constructed
+    missing = set(RULES) - set(AST_CASES) - set(REPO_CASES) - constructed
     assert not missing, f"rules without fixture coverage: {missing}"
 
 
@@ -188,6 +189,43 @@ def test_env_toggle_read_forms_and_scope(tmp_path):
                  "PBCCS_FORM_SETDEFAULT", "PBCCS_FORM_GETENV"):
         assert any(name in m for m in msgs), (name, msgs)
     assert not any("JAX_PLATFORMS" in m for m in msgs)
+
+
+def _span_repo(tmp_path: pathlib.Path, fixture: str) -> pathlib.Path:
+    """Mini repo for the REG010 fixtures: the fixture file under
+    pbccs_tpu/ plus a DESIGN.md whose span table lists ONLY
+    `reg010.documented` (the REPO_CASES contract in cases.py)."""
+    pkg = tmp_path / "pbccs_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text((FIXTURES / fixture).read_text())
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "DESIGN.md").write_text(textwrap.dedent("""\
+        <!-- ccs-analyze:spans-table:begin -->
+        | span | purpose | source |
+        |---|---|---|
+        | `reg010.documented` | a documented span | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:spans-table:end -->
+    """))
+    return tmp_path
+
+
+def test_reg010_fires_on_positive_fixture(tmp_path):
+    pos, _neg = REPO_CASES["REG010"]
+    root = _span_repo(tmp_path, pos)
+    found = [f for f in run_passes(root) if f.rule == "REG010"]
+    assert any("reg010.undocumented" in f.message for f in found), found
+    # the table-side direction: `reg010.documented` is listed but the
+    # positive fixture never records it
+    assert any("reg010.documented" in f.message
+               and f.path == "docs/DESIGN.md" for f in found), found
+
+
+def test_reg010_quiet_on_negative_fixture(tmp_path):
+    _pos, neg = REPO_CASES["REG010"]
+    root = _span_repo(tmp_path, neg)
+    found = [f for f in run_passes(root) if f.rule == "REG010"]
+    assert found == [], found
 
 
 def test_metric_kind_mismatch_is_drift(tmp_path):

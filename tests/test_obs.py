@@ -373,3 +373,227 @@ class TestSummaryAndRegistry:
         reg.counter("c_total", stage='we"ird\n').inc()
         text = reg.render_prometheus()
         assert 'stage="we\\"ird\\n"' in text
+
+
+# ------------------------------------------- fleet observability plane
+
+
+class TestTraceContext:
+    def test_ctx_span_exports_trace_identity(self):
+        tracer = obs_trace.Tracer(tag="t1")
+        with tracer.span("serve.prep",
+                         ctx={"trace_id": "abc", "span_id": "rt-q1"}):
+            with tracer.span("inner"):
+                pass
+        events = {e["name"]: e for e in
+                  tracer.to_chrome()["traceEvents"]}
+        prep = events["serve.prep"]["args"]
+        assert prep["trace_id"] == "abc"
+        assert prep["remote_parent"] == "rt-q1"
+        assert prep["span_id"] == "t1-0"
+        # children INHERIT the trace id through the thread stack
+        inner = events["inner"]["args"]
+        assert inner["trace_id"] == "abc"
+        assert "remote_parent" not in inner
+
+    def test_add_span_pins_explicit_span_id(self):
+        tracer = obs_trace.Tracer()
+        sp = tracer.add_span("router.request", 0.25,
+                             ctx={"trace_id": "abc", "span_id": "cl-0"},
+                             span_id="rt-q9", replica="r1")
+        assert sp is not None and not sp.open
+        ev = tracer.to_chrome()["traceEvents"][0]
+        assert ev["args"]["span_id"] == "rt-q9"
+        assert ev["args"]["remote_parent"] == "cl-0"
+        assert ev["dur"] == pytest.approx(250_000, rel=0.05)
+
+    def test_current_context_round_trip(self):
+        tracer = obs_trace.Tracer(tag="cli")
+        prev = obs_trace.set_tracer(None)
+        try:
+            assert obs_trace.install_tracer(tracer)
+            assert obs_trace.current_context() is None  # not in a span
+            with obs_trace.span("load", ctx={"trace_id": "t",
+                                             "span_id": None}):
+                ctx = obs_trace.current_context()
+                assert ctx == {"trace_id": "t", "span_id": "cli-0"}
+            assert obs_trace.clear_tracer(tracer)
+        finally:
+            obs_trace.set_tracer(prev)
+
+    def test_open_spans_tagged_not_zero_duration(self):
+        """Satellite contract: a mid-flight capture tags still-open
+        spans open=true with duration measured to the capture instant,
+        and the export metadata surfaces dropped/open counts."""
+        import time as _time
+
+        tracer = obs_trace.Tracer(max_spans=2)
+        with tracer.span("outer"):
+            _time.sleep(0.01)
+            chrome = tracer.to_chrome()       # captured mid-flight
+        with tracer.span("later"):
+            pass
+        with tracer.span("past-cap"):
+            pass
+        ev = chrome["traceEvents"][0]
+        assert ev["args"]["open"] is True
+        assert ev["dur"] >= 10_000            # >= the 10 ms slept, in us
+        assert chrome["meta"]["open_spans"] == 1
+        final = tracer.to_chrome()
+        assert "open" not in final["traceEvents"][0]["args"]
+        assert final["meta"]["dropped_spans"] == 1
+        assert final["meta"]["open_spans"] == 0
+        assert "origin_unix" in final["meta"]
+
+
+class TestSeriesCap:
+    def test_cap_drops_new_label_sets_and_counts(self):
+        reg = MetricsRegistry(max_series_per_name=2)
+        a = reg.counter("ccs_x_total", peer="a")
+        b = reg.counter("ccs_x_total", peer="b")
+        c = reg.counter("ccs_x_total", peer="c")   # past the cap
+        d = reg.counter("ccs_x_total", peer="d")
+        for m in (a, b, c, d):
+            m.inc()
+        text = reg.render_prometheus()
+        assert 'ccs_x_total{peer="a"}' in text
+        assert 'ccs_x_total{peer="b"}' in text
+        assert 'peer="c"' not in text and 'peer="d"' not in text
+        assert ('ccs_metrics_series_dropped_total{metric="ccs_x_total"}'
+                ' 2') in text
+        # existing series keep working past the cap
+        assert reg.counter("ccs_x_total", peer="a") is a
+        # a dropped label set counts ONCE and hands back the SAME
+        # cached detached instrument on every later lookup (no
+        # per-update allocation, no runaway drop counter)
+        again = reg.counter("ccs_x_total", peer="c")
+        assert again is c
+        again.inc()
+        text2 = reg.render_prometheus()
+        assert ('ccs_metrics_series_dropped_total{metric="ccs_x_total"}'
+                ' 2') in text2
+        with pytest.raises(TypeError):
+            reg.gauge("ccs_x_total", peer="c")   # kind mismatch holds
+
+    def test_dropped_instrument_is_usable_but_detached(self):
+        reg = MetricsRegistry(max_series_per_name=1)
+        reg.histogram("h_seconds", buckets=(1.0,), peer="a")
+        ghost = reg.histogram("h_seconds", buckets=(1.0,), peer="b")
+        ghost.observe(0.5)   # must not raise
+        assert ghost.count == 1
+        assert ('h_seconds', (("peer", "b"),)) not in reg.snapshot()
+
+    def test_set_series_cap_validates(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.set_series_cap(0)
+        reg.set_series_cap(3)
+
+
+class TestFederationHelpers:
+    def test_relabel_injects_into_all_sample_forms(self):
+        from pbccs_tpu.obs.metrics import relabel_exposition
+
+        body = ('# TYPE a_total counter\n'
+                'a_total 3\n'
+                'a_total{x="1"} 4\n'
+                'h_bucket{le="+Inf"} 7\n')
+        out = relabel_exposition(body, replica="r:1")
+        assert 'a_total{replica="r:1"} 3' in out
+        assert 'a_total{x="1",replica="r:1"} 4' in out
+        assert 'h_bucket{le="+Inf",replica="r:1"} 7' in out
+        assert '# TYPE a_total counter' in out
+
+    def test_merge_groups_by_name_with_one_type_line(self):
+        from pbccs_tpu.obs.metrics import merge_expositions
+
+        merged = merge_expositions([
+            "# TYPE a_total counter\na_total 1\n",
+            '# TYPE a_total counter\na_total{replica="x"} 2\n',
+        ])
+        assert merged.count("# TYPE a_total counter") == 1
+        assert "a_total 1" in merged
+        assert 'a_total{replica="x"} 2' in merged
+
+    def test_histogram_quantile(self):
+        from pbccs_tpu.obs.metrics import histogram_quantile
+
+        bounds = (0.1, 0.2, 0.4)
+        assert histogram_quantile((10, 0, 0, 0), bounds, 0.99) == 0.1
+        assert histogram_quantile((50, 49, 1, 0), bounds, 0.99) == 0.2
+        assert histogram_quantile((0, 0, 0, 5), bounds, 0.5) == 0.4
+        import math
+        assert math.isnan(histogram_quantile((0, 0, 0, 0), bounds, 0.5))
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_gauges(self):
+        from pbccs_tpu.obs import flight
+
+        rec = flight.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record_round("b0", i, live=8 - i if i < 8 else 0,
+                             n_zmws=8, z=16)
+        snap = rec.snapshot()
+        assert len(snap) == 4                  # ring stays bounded
+        assert snap[-1]["round"] == 9
+        assert snap[-1]["padding_waste"] == 0.5
+        reg = obs_metrics.default_registry()
+        snapshot = reg.snapshot()
+        key = ("ccs_refine_padding_waste", ())
+        assert key in snapshot
+
+    def test_dump_logs_and_keeps(self):
+        from pbccs_tpu.obs import flight
+
+        rec = flight.FlightRecorder(capacity=8)
+        rec.record_round("b1", 0, 4, 4, 8)
+
+        class FakeLog:
+            def __init__(self):
+                self.lines = []
+
+            def warn(self, msg):
+                self.lines.append(msg)
+
+        log = FakeLog()
+        out = rec.dump("test-reason", log)
+        assert len(out) == 1
+        assert log.lines and "test-reason" in log.lines[0]
+        assert rec.snapshot()                  # keep=True by default
+
+
+class TestStageHistogramsAndSlo:
+    def test_stage_latency_and_slo_counters_advance(self):
+        """A served request leaves per-stage samples and, with a tiny
+        --sloP99Ms, a burn-rate violation; the status verb carries the
+        slo block."""
+        from pbccs_tpu.serve.client import CcsClient
+        from pbccs_tpu.serve.server import CcsServer
+        from tests.test_serve import stub_engine
+
+        reg = obs_metrics.default_registry()
+        scope = reg.scope()
+        eng = stub_engine(max_batch=1, max_wait_ms=20.0)
+        # impossible objective: every request violates
+        object.__setattr__(eng.config, "slo_p99_ms", 1e-6)
+        eng.start()
+        srv = CcsServer(eng, port=0).start()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                msg = cli.submit("m/1", ["ACGTACGT"] * 4).reply(10.0)
+                assert msg["status"] == "Success"
+                st = cli.status()
+                assert st["slo"]["enabled"] is True
+                assert st["slo"]["target_p99_ms"] == 1e-6
+        finally:
+            srv.shutdown()
+            eng.close()
+        delta = scope.delta()
+        stages = {k[1][0][1] for k, v in delta.items()
+                  if k[0] == "ccs_serve_stage_latency_seconds"
+                  and v[2] > 0}
+        assert {"admission", "prepare", "queue", "dispatch", "polish",
+                "emit"} <= stages
+        assert scope.counter_value("ccs_slo_requests_total") >= 1
+        assert scope.counter_value("ccs_slo_violations_total") >= 1

@@ -68,6 +68,8 @@ class FakeReplica:
         # admission-weighting lever: work other clients put on us)
         self.pending = 0
         self.received: list[str] = []
+        self.submits: list[dict] = []   # full submit frames, in order
+        self.trace_actions: list[str] = []   # trace verb fan-out record
         self.held: list[tuple] = []
         self._lock = threading.Lock()
         self._conns: list[socket.socket] = []
@@ -114,10 +116,20 @@ class FakeReplica:
                                           "id": msg.get("id"),
                                           "accepting": self.accepting,
                                           "pending": self.pending})
+                elif verb == "trace":
+                    with self._lock:
+                        self.trace_actions.append(msg.get("action"))
+                    self._send(conn, {"type": "trace",
+                                      "id": msg.get("id"),
+                                      "state": "stopped"
+                                      if msg.get("action") == "stop"
+                                      else "started",
+                                      "trace": {"traceEvents": []}})
                 elif verb == "submit":
                     rid = msg.get("id")
                     with self._lock:
                         self.received.append(rid)
+                        self.submits.append(msg)
                     if self.mode == "echo":
                         self._send(conn, fake_result(rid, msg))
                     elif self.mode == "hold":
@@ -777,3 +789,146 @@ def test_engine_status_reports_accepting():
         srv.shutdown()
         eng.close()
     assert eng.status()["accepting"] is False
+
+
+# ------------------------------------------------- trace-context plumbing
+
+
+class TestTraceContext:
+    """The fleet observability plane's wire contract: trace_id survives
+    the router's id rewriting and failover re-dispatch; span_id is
+    rewritten to the router's per-request span on the replica hop."""
+
+    def test_trace_id_survives_id_rewrite(self, fakes_pair):
+        router, server = make_router(fakes_pair)
+        try:
+            with CcsClient(server.host, server.port) as cli:
+                msg = cli.submit_wire(
+                    dict(ZMW), trace={"trace_id": "feedc0de00000001",
+                                      "span_id": "cl-7"}).reply(10.0)
+                assert msg["status"] == "Success"
+            frames = [m for f in fakes_pair for m in f.submits]
+            assert len(frames) == 1
+            tr = frames[0]["trace"]
+            # trace_id untouched; span_id rewritten to the router's
+            # per-request span, matching the rewritten request id
+            assert tr["trace_id"] == "feedc0de00000001"
+            assert tr["span_id"] == f"rt-{frames[0]['id']}"
+            assert tr["span_id"] != "cl-7"
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_trace_follows_failover_redispatch(self, fakes_pair):
+        a, b = fakes_pair
+        a.mode = b.mode = "hold"
+        router, server = make_router(fakes_pair)
+        try:
+            with CcsClient(server.host, server.port) as cli:
+                handle = cli.submit_wire(
+                    dict(ZMW), trace={"trace_id": "feedc0de00000002",
+                                      "span_id": None})
+                assert wait_until(lambda: a.submits or b.submits)
+                first = a if a.submits else b
+                second = b if first is a else a
+                first.drop()     # connection loss -> failover
+                assert wait_until(lambda: second.submits)
+                second.release()   # answer the re-dispatched copy
+                msg = handle.reply(10.0)
+                assert msg["status"] == "Success"
+            # both replicas saw the SAME trace_id and the SAME router
+            # span id (failover re-dispatches the identical frame)
+            f1, f2 = first.submits[-1], second.submits[-1]
+            assert f1["trace"]["trace_id"] == "feedc0de00000002"
+            assert f1["trace"] == f2["trace"]
+            assert f1["id"] == f2["id"]
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_router_mints_trace_id_when_capture_live(self, fakes_pair):
+        router, server = make_router(fakes_pair)
+        try:
+            assert router.trace_start()
+            try:
+                with CcsClient(server.host, server.port) as cli:
+                    # no explicit trace field: the client's auto-context
+                    # is also absent (this thread is inside no span), so
+                    # the router edge must mint the id
+                    msg = cli.submit_wire(dict(ZMW)).reply(10.0)
+                    assert msg["status"] == "Success"
+            finally:
+                bundle = router.trace_stop(timeout_s=2.0)
+            frames = [m for f in fakes_pair for m in f.submits]
+            assert len(frames) == 1
+            # edge-minted: a fresh 16-hex id, span_id = router span
+            tr = frames[0]["trace"]
+            assert len(tr["trace_id"]) == 16
+            assert tr["span_id"] == f"rt-{frames[0]['id']}"
+            # the router recorded a retroactive per-request span whose
+            # exported span_id matches the forwarded remote parent
+            events = bundle["trace"]["traceEvents"]
+            mine = [e for e in events if e["name"] == "router.request"]
+            assert mine and mine[0]["args"]["span_id"] == tr["span_id"]
+            assert mine[0]["args"]["trace_id"] == tr["trace_id"]
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_replica_span_parents_under_inbound_context(self):
+        from pbccs_tpu.obs import trace as obs_trace
+
+        eng, srv = stub_serve_stack()
+        cap = obs_trace.Tracer(tag="rep")
+        assert obs_trace.install_tracer(cap)
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                msg = cli.submit_wire(
+                    dict(ZMW), trace={"trace_id": "feedc0de00000003",
+                                      "span_id": "rt-q9"}).reply(10.0)
+                assert msg["status"] == "Success"
+        finally:
+            obs_trace.clear_tracer(cap)
+            srv.shutdown()
+            eng.close()
+        preps = [e for e in cap.to_chrome()["traceEvents"]
+                 if e["name"] == "serve.prep"]
+        assert preps
+        args = preps[0]["args"]
+        assert args["trace_id"] == "feedc0de00000003"
+        assert args["remote_parent"] == "rt-q9"
+        assert args["span_id"].startswith("rep-")
+
+    def test_malformed_trace_is_bad_request(self):
+        eng, srv = stub_serve_stack()
+        try:
+            with CcsClient(srv.host, srv.port) as cli:
+                with pytest.raises(ServeError) as ei:
+                    cli.submit_wire(dict(ZMW),
+                                    trace={"trace_id": 7}).reply(10.0)
+                assert ei.value.code == "bad_request"
+        finally:
+            srv.shutdown()
+            eng.close()
+
+
+def test_router_close_stops_replica_captures(fakes_pair=None):
+    """Regression: close() must fan the trace stop out while the
+    replica links are still alive -- a torn-down-first order left every
+    replica's globally-installed tracer running forever."""
+    fakes = [FakeReplica(), FakeReplica()]
+    router, server = make_router(fakes)
+    try:
+        assert router.trace_start()
+        assert wait_until(lambda: all(
+            f.trace_actions[:1] == ["start"] for f in fakes))
+        router.close()
+        for f in fakes:
+            assert "stop" in f.trace_actions, f.trace_actions
+        from pbccs_tpu.obs import trace as obs_trace
+        assert obs_trace.get_tracer() is None   # router capture cleared
+    finally:
+        server.shutdown()
+        router.close()
+        for f in fakes:
+            f.close()

@@ -12,6 +12,16 @@ Legs:
 
   baseline  offline process_chunks over the workload (the byte-identity
             reference), computed in-process
+  trace     the fleet observability plane: a router-driven trace capture
+            fans out to every replica, requests submitted WITH wire
+            trace context stream through, and the stopped capture merges
+            (tools/trace_merge.py) into one Perfetto timeline in which
+            every request's spans form ONE connected tree crossing the
+            router and a replica process under one trace_id; a single
+            router `metrics` scrape returns replica-labeled exposition
+            for every replica.  Both artifacts (merged trace, federated
+            exposition) are written to $ARTIFACTS_DIR (default
+            /tmp/ccs-fleet-artifacts) for CI upload.
   kill9     24 requests streamed through the router; one replica with
             requests in flight is kill -9'd: every request answers
             EXACTLY once (raw-socket reply counting, not a client that
@@ -73,25 +83,29 @@ def make_workload():
     return chunks, wires
 
 
-def spawn_ready(subcmd_args: list[str],
-                marker: str) -> tuple[subprocess.Popen, int]:
+def spawn_ready(subcmd_args: list[str], marker: str
+                ) -> tuple[subprocess.Popen, int, list[str]]:
     """One `ccs <subcmd>` subprocess; block until its machine-readable
-    ready line (`CCS-*-READY HOST PORT`) and return (proc, port)."""
+    ready line (`CCS-*-READY HOST PORT`) and return (proc, port,
+    pre-ready stdout lines) -- the extra lines carry secondary ready
+    markers like CCS-METRICS-READY, printed before the main one."""
     proc = subprocess.Popen(
         [sys.executable, "-m", "pbccs_tpu.cli"] + subcmd_args,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    preamble: list[str] = []
     line = proc.stdout.readline()
     while line and not line.startswith(marker):
+        preamble.append(line)
         line = proc.stdout.readline()
     if not line:
         proc.kill()
         raise SystemExit(f"{marker} never seen (rc={proc.poll()})")
-    return proc, int(line.split()[2])
+    return proc, int(line.split()[2]), preamble
 
 
 def spawn_replica() -> tuple[subprocess.Popen, int]:
-    return spawn_ready(
+    proc, port, _pre = spawn_ready(
         ["serve", "--port", "0", "--maxBatch", "4", "--maxWaitMs", "250",
          # the router multiplexes every client over ONE replica session:
          # size the per-session cap to the admission bound so the armor
@@ -99,14 +113,25 @@ def spawn_replica() -> tuple[subprocess.Popen, int]:
          "--maxInflightPerSession", "256",
          "--drainTimeout", "300", "--logLevel", "ERROR"],
         "CCS-SERVE-READY")
+    return proc, port
 
 
-def spawn_router(ports: list[int]) -> tuple[subprocess.Popen, int]:
+def spawn_router(ports: list[int]
+                 ) -> tuple[subprocess.Popen, int, int]:
+    """Router subprocess with an ephemeral HTTP /metrics endpoint;
+    returns (proc, router_port, metrics_port).  CCS-METRICS-READY is
+    printed before CCS-ROUTER-READY, so it rides spawn_ready's
+    preamble."""
     argv = ["router", "--port", "0", "--logLevel", "ERROR",
-            "--routerHealthInterval", "0.5", "--routerHealthTimeout", "3"]
+            "--routerHealthInterval", "0.5", "--routerHealthTimeout", "3",
+            "--metricsPort", "-1"]
     for p in ports:
         argv += ["--replica", f"127.0.0.1:{p}"]
-    return spawn_ready(argv, "CCS-ROUTER-READY")
+    proc, port, preamble = spawn_ready(argv, "CCS-ROUTER-READY")
+    metrics_port = next(
+        (int(line.split()[2]) for line in preamble
+         if line.startswith("CCS-METRICS-READY")), -1)
+    return proc, port, metrics_port
 
 
 def router_status(port: int) -> dict:
@@ -119,16 +144,26 @@ def router_status(port: int) -> dict:
                 return msg
 
 
-def router_metrics(port: int) -> dict[str, float]:
-    with socket.create_connection(("127.0.0.1", port), timeout=30.0) as c:
-        c.sendall(b'{"verb":"metrics","id":"m"}\n')
+def router_verb(port: int, frame: dict, timeout: float = 60.0) -> dict:
+    """One-shot verb round trip on a fresh router session."""
+    rid = frame.get("id")
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as c:
+        c.sendall(json.dumps(frame).encode() + b"\n")
         rf = c.makefile("rb")
         while True:
             msg = json.loads(rf.readline())
-            if msg.get("id") == "m":
-                break
+            if msg.get("id") == rid:
+                return msg
+
+
+def router_metrics_body(port: int) -> str:
+    return router_verb(port, {"verb": "metrics", "id": "m"}).get("body", "")
+
+
+def router_metrics(port: int) -> dict[str, float]:
     out: dict[str, float] = {}
-    for line in msg.get("body", "").splitlines():
+    for line in router_metrics_body(port).splitlines():
         if line and not line.startswith("#"):
             name, _, value = line.rpartition(" ")
             try:
@@ -191,6 +226,97 @@ def run_leg(name: str, router_port: int, wires, prefix: str,
     return results
 
 
+def artifacts_dir() -> str:
+    d = os.environ.get("ARTIFACTS_DIR", "/tmp/ccs-fleet-artifacts")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def run_trace_leg(router_port: int, metrics_port: int, wires) -> None:
+    """The observability-plane leg: fleet-wide trace capture + merged
+    timeline + federated metrics scrape (see module docstring)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge
+
+    start = router_verb(router_port,
+                        {"verb": "trace", "id": "ts", "action": "start"})
+    check("trace: fleet capture started", start.get("state") == "started",
+          str(start.get("state")))
+
+    # submit every ZMW with wire trace context on one raw session
+    conn = socket.create_connection(("127.0.0.1", router_port),
+                                    timeout=REPLY_TIMEOUT_S)
+    rf = conn.makefile("rb")
+    trace_ids = {}
+    for i, z in enumerate(wires):
+        rid = f"t{i}"
+        trace_ids[rid] = f"{i + 1:016x}"
+        conn.sendall(json.dumps(
+            {"verb": "submit", "id": rid, "zmw": z,
+             "trace": {"trace_id": trace_ids[rid],
+                       "span_id": f"client-{i}"}}).encode() + b"\n")
+    results = {}
+    while len(results) < len(wires):
+        msg = json.loads(rf.readline())
+        if msg.get("id") in trace_ids:
+            results[msg["id"]] = msg
+    conn.close()
+    check("trace: all traced submits answered Success",
+          all(m.get("status") == "Success" for m in results.values()),
+          str({m.get("status") or m.get("code")
+               for m in results.values()}))
+
+    stop = router_verb(router_port,
+                       {"verb": "trace", "id": "tp", "action": "stop"},
+                       timeout=120.0)
+    check("trace: fleet capture stopped", stop.get("state") == "stopped",
+          str(stop.get("state")))
+    check("trace: replica dumps collected",
+          len(stop.get("replicas", {})) >= 2,
+          f"{len(stop.get('replicas', {}))} replica dump(s)")
+
+    merged = trace_merge.merge_docs(trace_merge.expand_bundle(stop))
+    report = trace_merge.request_trees(merged)
+    bad = []
+    for rid, tid in trace_ids.items():
+        tree = report.get(tid)
+        if tree is None or tree["components"] != 1 \
+                or len(tree["processes"]) < 2:
+            bad.append((rid, tid, tree))
+    check("trace: every request is ONE connected tree crossing "
+          "router+replica", not bad, str(bad[:3]))
+
+    # federated scrape: ONE HTTP GET on the router's --metricsPort must
+    # return replica-labeled exposition for the whole fleet (the NDJSON
+    # metrics verb serves the identical body)
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            timeout=60.0) as resp:
+        body = resp.read().decode()
+    replicas = {line.split('replica="')[1].split('"')[0]
+                for line in body.splitlines()
+                if line.startswith("ccs_serve_admitted_total{")
+                and 'replica="' in line}
+    check("trace: one /metrics scrape carries >= 2 replica labels",
+          len(replicas) >= 2, f"replicas={sorted(replicas)}")
+    check("trace: router-local series survive federation",
+          any(line.startswith("ccs_router_routed_total")
+              for line in body.splitlines()))
+
+    # CI artifacts: the merged fleet timeline + the federated snapshot
+    out = artifacts_dir()
+    with open(os.path.join(out, "fleet_trace.json"), "w") as f:
+        json.dump(merged, f)
+    with open(os.path.join(out, "fleet_metrics.prom"), "w") as f:
+        f.write(body)
+    print(f"  artifacts: {out}/fleet_trace.json "
+          f"({len(merged['traceEvents'])} events), "
+          f"{out}/fleet_metrics.prom ({len(body.splitlines())} lines)",
+          flush=True)
+
+
 def wait_for_victim(router_port: int, deadline_s: float = 120.0) -> str:
     """Block until some replica has requests in flight; return its name
     (the chaos target must demonstrably be mid-stream)."""
@@ -224,8 +350,11 @@ def main() -> int:
 
     replicas = [spawn_replica() for _ in range(REPLICAS)]
     ports = [port for _, port in replicas]
-    router_proc, router_port = spawn_router(ports)
+    router_proc, router_port, metrics_port = spawn_router(ports)
     try:
+        print("== leg: fleet trace + metrics federation ==", flush=True)
+        run_trace_leg(router_port, metrics_port, wires)
+
         print("== leg: replica kill -9 mid-stream ==", flush=True)
         m0 = router_metrics(router_port)
 

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
 """Observability smoke gate: run a tiny simulated workload through the
-CLI with --trace-out and validate the emitted Chrome-trace JSON schema.
+CLI with --trace-out and validate the emitted Chrome-trace JSON schema,
+then validate the FLEET-trace merge schema (tools/trace_merge.py) over
+two in-process tracers exchanging wire trace context.
 
 Part of tier-1 (tools/tier1.sh + .github/workflows/tier1.yml): the trace
 export is an interface later perf PRs read, so its shape is pinned in CI
 -- traceEvents present, complete ("X") events with ts/dur/pid/tid, the
 span tree covering filter -> draft -> polish -> emit, device-wait
-attribution on every span, and parent links that resolve.
+attribution on every span, and parent links that resolve.  The fleet leg
+pins the MERGED schema: one pid + process_name row per process,
+wall-clock-rebased timelines, remote_parent links resolving across
+processes into one connected tree per trace_id, and dropped/open-span
+metadata surviving the merge.
 
 Exit 0 on success; prints the failure and exits 1 otherwise.
 
@@ -82,6 +88,66 @@ def validate_trace(trace: dict) -> list[str]:
     return problems
 
 
+def validate_fleet_merge() -> list[str]:
+    """The fleet-trace-schema leg: a simulated router + replica pair
+    exchange wire trace context in-process, and the merged doc must
+    carry the multi-process schema fleet_smoke and dashboards key on."""
+    from pbccs_tpu.obs import trace as obs_trace
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge
+
+    problems: list[str] = []
+    router = obs_trace.Tracer(tag="router")
+    replica = obs_trace.Tracer(tag="rep1", max_spans=3)
+    tid = obs_trace.new_trace_id()
+    # replica-side spans parent under the router's per-request span id
+    with replica.span("serve.prep",
+                      ctx={"trace_id": tid, "span_id": "rt-q1"}):
+        with replica.span("serve.polish"):
+            pass
+    with replica.span("spilled", i=0):       # left open at capture
+        with replica.span("dropped-by-cap"):  # past max_spans: dropped
+            pass
+        replica_doc = replica.to_chrome()
+    router.add_span("router.request", 0.005,
+                    ctx={"trace_id": tid, "span_id": "cl-0"},
+                    span_id="rt-q1", replica="rep1")
+    merged = trace_merge.merge_docs([("router", router.to_chrome()),
+                                     ("replica rep1", replica_doc)])
+
+    metas = [ev for ev in merged["traceEvents"]
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"]
+    if {m["args"]["name"] for m in metas} != {"router", "replica rep1"}:
+        problems.append(f"process_name metadata wrong: {metas}")
+    pids = {ev["pid"] for ev in merged["traceEvents"]
+            if ev.get("ph") == "X"}
+    if len(pids) != 2:
+        problems.append(f"expected 2 pids, got {sorted(pids)}")
+    report = trace_merge.request_trees(merged)
+    tree = report.get(tid)
+    if tree is None:
+        problems.append(f"trace {tid} missing from report {report}")
+    else:
+        if tree["components"] != 1:
+            problems.append(f"trace {tid} not connected: {tree}")
+        if len(tree["processes"]) != 2:
+            problems.append(f"trace {tid} did not cross processes: {tree}")
+    if merged["meta"].get("dropped_spans", 0) < 1:
+        problems.append("dropped_spans did not survive the merge")
+    if merged["meta"].get("open_spans", 0) < 1:
+        problems.append("open_spans did not survive the merge")
+    open_ev = [ev for ev in merged["traceEvents"]
+               if ev.get("args", {}).get("open")]
+    if not open_ev or any(ev["dur"] <= 0 for ev in open_ev):
+        problems.append("open span not tagged with a capture-time "
+                        f"duration: {open_ev}")
+    flows = [ev for ev in merged["traceEvents"] if ev.get("ph") == "s"]
+    if not flows:
+        problems.append("no flow event links the cross-process parent")
+    return problems
+
+
 def main() -> int:
     from pbccs_tpu import cli
 
@@ -103,9 +169,15 @@ def main() -> int:
         for p in problems:
             print(f"obs_smoke: {p}", file=sys.stderr)
         return 1
+    problems = validate_fleet_merge()
+    if problems:
+        for p in problems:
+            print(f"obs_smoke (fleet merge): {p}", file=sys.stderr)
+        return 1
     n = len(trace["traceEvents"])
     print(f"obs_smoke: OK ({n} spans, schema valid, "
-          f"spans cover {sorted(REQUIRED_SPANS)})")
+          f"spans cover {sorted(REQUIRED_SPANS)}; fleet-merge schema "
+          "valid)")
     return 0
 
 

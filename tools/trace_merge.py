@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome-trace dumps into ONE fleet Perfetto timeline.
+
+Each process in the serving fleet (router, every `ccs serve` replica, a
+traced client) captures its own span tree (pbccs_tpu/obs/trace.py).
+The router's `trace` verb (action=stop) returns them all in one bundle:
+
+    {"type": "trace", "state": "stopped",
+     "trace": {..router chrome..},
+     "replicas": {"host:port": {..replica chrome..}, ...}}
+
+This tool assembles the bundle (or any set of chrome dumps) into a
+single Chrome-trace JSON that ui.perfetto.dev renders as one timeline:
+
+  * every input process gets its own pid + process_name metadata row;
+  * timelines are REBASED onto one axis using each tracer's wall-clock
+    origin (`meta.origin_unix`) -- perf_counter origins are per-process
+    arbitrary, the wall clock is shared (sub-ms skew on one host);
+  * cross-process parent links (args.remote_parent naming another
+    process's args.span_id, the wire trace-context contract) become
+    Chrome flow events, so a request's client -> router -> replica
+    chain draws as connected arrows;
+  * `meta` totals dropped/open spans across the fleet so a truncated
+    capture is visible in the artifact itself.
+
+`request_trees()` / `trace_connected()` are the assertions
+tools/fleet_smoke.py and tools/obs_smoke.py gate CI on: every request's
+spans must form ONE connected tree under its trace_id.
+
+Usage:
+    python tools/trace_merge.py bundle.json -o merged.json
+    python tools/trace_merge.py router.json replica1.json -o merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def merge_docs(docs: list[tuple[str, dict]]) -> dict[str, Any]:
+    """Merge (process_name, chrome_doc) pairs into one Chrome-trace
+    object (see module docstring for the semantics)."""
+    origins = [d.get("meta", {}).get("origin_unix")
+               for _, d in docs]
+    known = [o for o in origins if isinstance(o, (int, float))]
+    base = min(known) if known else 0.0
+
+    events: list[dict] = []
+    processes: dict[str, int] = {}
+    dropped = open_spans = 0
+    by_span_id: dict[str, dict] = {}
+    for i, (name, doc) in enumerate(docs):
+        pid = i + 1
+        processes[name] = pid
+        meta = doc.get("meta", {})
+        dropped += int(meta.get("dropped_spans", 0) or 0)
+        open_spans += int(meta.get("open_spans", 0) or 0)
+        origin = meta.get("origin_unix")
+        shift_us = ((origin - base) * 1e6
+                    if isinstance(origin, (int, float)) else 0.0)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(ev["ts"] + shift_us, 1)
+            events.append(ev)
+            sid = ev.get("args", {}).get("span_id")
+            if isinstance(sid, str):
+                by_span_id.setdefault(sid, ev)
+
+    # cross-process parent links -> Chrome flow events (drawn as arrows)
+    flow_seq = 0
+    flows: list[dict] = []
+    for ev in events:
+        rp = ev.get("args", {}).get("remote_parent")
+        if not isinstance(rp, str):
+            continue
+        parent = by_span_id.get(rp)
+        if parent is None or parent is ev:
+            continue
+        flow_seq += 1
+        common = {"cat": "trace-context", "name": "trace", "id": flow_seq}
+        flows.append({**common, "ph": "s", "pid": parent["pid"],
+                      "tid": parent.get("tid", 0),
+                      "ts": parent.get("ts", 0)})
+        flows.append({**common, "ph": "f", "bp": "e", "pid": ev["pid"],
+                      "tid": ev.get("tid", 0), "ts": ev.get("ts", 0)})
+    return {
+        "traceEvents": events + flows,
+        "displayTimeUnit": "ms",
+        "meta": {"processes": processes, "dropped_spans": dropped,
+                 "open_spans": open_spans},
+    }
+
+
+def expand_bundle(obj: dict, router_name: str = "router"
+                  ) -> list[tuple[str, dict]]:
+    """(name, chrome) pairs from a router trace-stop reply bundle, or
+    from a bare chrome doc (single-process input)."""
+    if "replicas" in obj or ("trace" in obj
+                             and "traceEvents" not in obj):
+        docs = [(router_name, obj.get("trace") or {"traceEvents": []})]
+        for name, chrome in sorted((obj.get("replicas") or {}).items()):
+            docs.append((f"replica {name}", chrome))
+        return docs
+    return [(obj.get("meta", {}).get("process", router_name), obj)]
+
+
+# ------------------------------------------------------- tree assertions
+
+def request_trees(merged: dict) -> dict[str, dict[str, Any]]:
+    """Per-trace_id connectivity report over a merged doc:
+    {trace_id: {"events": n, "components": k, "processes": [...]}} --
+    a request whose spans crossed the fleet under one trace shows
+    components == 1 and len(processes) >= 2."""
+    events = [ev for ev in merged.get("traceEvents", [])
+              if ev.get("ph") == "X"]
+    by_span_id = {ev["args"]["span_id"]: ev for ev in events
+                  if isinstance(ev.get("args", {}).get("span_id"), str)}
+    by_pid_index = {(ev["pid"], ev.get("id")): ev for ev in events}
+
+    parent: dict[int, int] = {}
+
+    def find(x: int) -> int:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: int, b: int) -> None:
+        parent[find(a)] = find(b)
+
+    ids = {id(ev): ev for ev in events}
+    for ev in events:
+        args = ev.get("args", {})
+        rp = args.get("remote_parent")
+        if isinstance(rp, str) and rp in by_span_id:
+            union(id(ev), id(by_span_id[rp]))
+        p = args.get("parent")
+        if p is not None and (ev["pid"], p) in by_pid_index:
+            union(id(ev), id(by_pid_index[(ev["pid"], p)]))
+
+    out: dict[str, dict[str, Any]] = {}
+    for tid in sorted({ev["args"].get("trace_id") for ev in events
+                       if ev.get("args", {}).get("trace_id")}):
+        mine = [ev for ev in events if ev["args"].get("trace_id") == tid]
+        roots = {find(id(ev)) for ev in mine}
+        out[tid] = {
+            "events": len(mine),
+            "components": len(roots),
+            "processes": sorted({ev["pid"] for ev in mine}),
+            "spans": sorted({ev["name"] for ev in mine}),
+        }
+    del ids
+    return out
+
+
+def trace_connected(merged: dict, trace_id: str) -> bool:
+    """True when trace_id's spans form ONE connected tree."""
+    report = request_trees(merged).get(trace_id)
+    return report is not None and report["components"] == 1
+
+
+# ------------------------------------------------------------------- CLI
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="Merge per-process CCS trace dumps (a router trace "
+                    "bundle or chrome JSON files) into one Perfetto "
+                    "timeline.")
+    p.add_argument("inputs", nargs="+",
+                   help="Router trace-stop bundle JSON and/or chrome "
+                        "trace JSON files.")
+    p.add_argument("-o", "--output", required=True,
+                   help="Merged Chrome-trace JSON output path.")
+    p.add_argument("--report", action="store_true",
+                   help="Print the per-trace connectivity report.")
+    args = p.parse_args(argv)
+
+    docs: list[tuple[str, dict]] = []
+    for path in args.inputs:
+        with open(path) as f:
+            obj = json.load(f)
+        base = os.path.splitext(os.path.basename(path))[0]
+        docs.extend(expand_bundle(obj, router_name=base))
+    merged = merge_docs(docs)
+
+    from pbccs_tpu.resilience.resources import atomic_output
+
+    with atomic_output(args.output, "trace") as f:
+        json.dump(merged, f)
+    report = request_trees(merged)
+    if args.report:
+        print(json.dumps(report, indent=2))
+    connected = sum(1 for r in report.values() if r["components"] == 1)
+    print(f"trace_merge: {len(docs)} process(es), "
+          f"{len(merged['traceEvents'])} event(s), "
+          f"{len(report)} trace(s) ({connected} connected) "
+          f"-> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
