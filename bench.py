@@ -134,6 +134,32 @@ def _peak_rss() -> int:
     return peak_rss_bytes()
 
 
+def _emit_ledger_record(scope, *, source: str, workload: dict,
+                        wall_s, zmws, kernel_fraction=None,
+                        regions=None, compile_s=None) -> None:
+    """Append one perf-ledger record for a bench row when
+    BENCH_PERF_LEDGER names a path (subprocess sweep rows inherit the
+    env and append their own records to the same journal -- O_APPEND
+    single-line writes interleave safely)."""
+    path = os.environ.get("BENCH_PERF_LEDGER")
+    if not path:
+        return
+    from pbccs_tpu.obs.ledger import PerfLedger, run_record
+
+    shares = None
+    if isinstance(regions, dict) and "error" not in regions:
+        shares = {k: v for k, v in regions.items()
+                  if isinstance(v, (int, float))}
+    ledger = PerfLedger(path)
+    ledger.append(run_record(
+        scope, kind="bench_row", source=source, workload=workload,
+        wall_s=wall_s, zmws=zmws, kernel_fraction=kernel_fraction,
+        region_shares=shares or None,
+        extra={"compile_s": round(compile_s, 3)}
+        if compile_s is not None else None))
+    ledger.close()
+
+
 def run_workload(tasks):
     """One full polish: setup + lockstep refinement + QV sweep.  The
     bench.* spans are no-ops unless a tracer is installed (the warmup
@@ -215,6 +241,12 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     rng = np.random.default_rng(20260729)
     tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes, n_corruptions)
 
+    # perf-ledger window over this row's whole polish work (warmup +
+    # timed repeats): the registry deltas become the row's ledger record
+    from pbccs_tpu.obs.metrics import default_registry
+
+    ledger_scope = default_registry().scope()
+
     # span rollup rides the UNTIMED warmup pass: a tracer is installed
     # around it (CAS -- skipped if someone else holds a capture) and
     # cleared before the timed repeats, so rows carry the per-stage span
@@ -294,6 +326,14 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     n_exact = sum(bool(np.array_equal(tpls[z], eval_truths[z]))
                   for z in range(n_zmws))
     mean_qv = float(np.mean([q.mean() for q in qvs]))
+    _emit_ledger_record(
+        ledger_scope, source="bench",
+        workload={"n_zmws": n_zmws, "tpl_len": tpl_len,
+                  "n_passes": str(n_passes), "batch": batch_size,
+                  "workers": workers},
+        wall_s=bench_s, zmws=n_zmws, compile_s=warm_s,
+        kernel_fraction=(regions or {}).get("kernel_fraction"),
+        regions=(regions or {}).get("regions"))
     return {
         "zmws_per_sec": n_zmws / bench_s,
         # effective overlapped-worker count (BENCH_WORKERS clamped to the
@@ -415,8 +455,10 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
             "--chunkSize", str(chunk), "--numThreads", "3", "--zmws", "all",
             "--reportFile", os.path.join(tmp, "ccs_report.csv")]
 
+    from pbccs_tpu.obs.metrics import default_registry
     from pbccs_tpu.runtime import timing
 
+    ledger_scope = default_registry().scope()
     repeats = int(os.environ.get("BENCH_E2E_REPEATS", 3))
     try:
         rc = cli.run(argv)  # warmup + correctness
@@ -437,6 +479,11 @@ def bench_end_to_end(n_zmws: int, tpl_len: int, n_passes: int,
     pick = int(np.argmin(np.abs(np.asarray(times) - e2e_s)))
     stages = {k: round(v, 3) for k, v in sorted(
         stage_runs[pick].items(), key=lambda kv: -kv[1])}
+    _emit_ledger_record(
+        ledger_scope, source="bench_e2e",
+        workload={"n_zmws": n_zmws, "tpl_len": tpl_len,
+                  "n_passes": str(n_passes), "chunk": chunk},
+        wall_s=e2e_s, zmws=n_zmws)
     return {
         "ccs_zmws_per_sec": n_zmws / e2e_s,
         "e2e_s": e2e_s,

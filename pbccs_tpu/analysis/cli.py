@@ -112,11 +112,13 @@ def _run(args) -> int:
             collect_fault_kinds,
             collect_fault_sites,
             collect_flag_defs,
+            collect_ledger_fields,
             collect_metrics,
             collect_spans,
             render_env_table,
             render_fault_kinds_table,
             render_flags_table,
+            render_ledger_table,
             render_metrics_table,
             render_sites_table,
             render_spans_table,
@@ -146,6 +148,9 @@ def _run(args) -> int:
         print()
         print(render_flags_table(collect_flag_defs(pkg),
                                  existing("flags-table")))
+        print()
+        led_fields, led_path, _ = collect_ledger_fields(pkg)
+        print(render_ledger_table(led_fields, led_path))
         return 0
 
     rules = ({r.strip() for r in args.rules.split(",") if r.strip()}

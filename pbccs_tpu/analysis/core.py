@@ -66,6 +66,9 @@ RULES = {
     "REG010": "trace span name drifted from the DESIGN.md span table "
               "(recorded but undocumented, or documented but never "
               "recorded)",
+    "REG011": "perf-ledger schema (obs.ledger.LEDGER_FIELDS) drifted "
+              "from the DESIGN.md ledger-schema table (field or "
+              "tolerance class disagrees, either direction)",
     "EXC001": "bare `except:` clause",
     "EXC002": "silent `except Exception/BaseException: pass` without a "
               "stated reason",

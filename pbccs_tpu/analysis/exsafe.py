@@ -50,6 +50,9 @@ from pbccs_tpu.analysis.core import Finding, SourceFile, dotted_name
 # per-record fsync and a torn-tail-tolerant loader)
 JOURNAL_WRITERS = {
     ("pbccs_tpu/resilience/checkpoint.py", "CheckpointJournal"),
+    # append-only NDJSON perf journal: flushed line records, torn-tail-
+    # tolerant reader (read_ledger), degrade-to-absence on write failure
+    ("pbccs_tpu/obs/ledger.py", "PerfLedger"),
 }
 
 _TMP_MARKER = ".tmp"

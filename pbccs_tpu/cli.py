@@ -168,6 +168,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(TensorBoard/XProf format).")
     p.add_argument("--reportFile", default="ccs_report.csv",
                    help="Where to write the yield report. Default = %(default)s")
+    p.add_argument("--perfLedger", default=None, metavar="PATH",
+                   help="Append one schema-versioned NDJSON performance "
+                        "record for this run (obs/ledger.py) to PATH: "
+                        "compile/refine/padding counters, wall time, "
+                        "peak RSS, governor interventions -- the record "
+                        "tools/perf_gate.py defends baselines against. "
+                        "Default: off.")
     p.add_argument("--checkpoint", default=None, metavar="FILE",
                    help="Journal completed chunks to FILE (NDJSON) so a "
                         "killed run can restart with --resume. Default: "
@@ -362,6 +369,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.analysis.cli import run_analyze
 
         return run_analyze(argv[1:])
+    if argv and argv[0] == "top":
+        # `ccs top`: live fleet console over a router/serve endpoint
+        from pbccs_tpu.obs.console import run_top
+
+        return run_top(argv[1:])
     args = build_parser().parse_args(argv)
     apply_resilience_args(args)
 
@@ -426,9 +438,13 @@ def run(argv: list[str] | None = None) -> int:
             tracer = None
     from pbccs_tpu.resilience.resources import OutputWriteError
 
+    import time as time_mod
+
+    t_run0 = time_mod.monotonic()
+    tally = None
     try:
         with profiling.profile_capture(args.profile_dir):
-            _run_pipeline(args, files, whitelist, settings, log)
+            tally = _run_pipeline(args, files, whitelist, settings, log)
     except OutputWriteError as e:
         # a full disk is an OPERATIONAL failure, not a bug: report what
         # was durably written and how to resume, exit nonzero without a
@@ -452,6 +468,23 @@ def run(argv: list[str] | None = None) -> int:
 
     summary = default_registry().summary_table(run_window)
     log.info("run metrics:\n" + summary)
+    if args.perfLedger:
+        # one perf-ledger record per run: the registry deltas over this
+        # run's window + what only the driver knows (wall, yield)
+        from pbccs_tpu.obs.ledger import PerfLedger, run_record
+
+        ledger = PerfLedger(args.perfLedger, logger=log)
+        ledger.append(run_record(
+            run_window, kind="batch_run", source="ccs",
+            workload={"files": [os.path.basename(f) for f in files],
+                      "chunk_size": args.chunkSize,
+                      "devices": args.devices,
+                      "model": args.model},
+            wall_s=time_mod.monotonic() - t_run0,
+            zmws=tally.total if tally is not None else None,
+            results=len(tally.results) if tally is not None else None))
+        ledger.close()
+        log.info(f"perf ledger record appended to {args.perfLedger}")
     log.flush()
     return 0
 

@@ -1,6 +1,6 @@
 """Unified observability layer: metrics, traces, and profiling hooks.
 
-Five cooperating pieces, all host-side and dependency-free (no jax
+Seven cooperating pieces, all host-side and dependency-free (no jax
 import at module load, so the CLI's argument errors stay fast):
 
   * obs.metrics -- a thread-safe MetricsRegistry (counters, gauges,
@@ -18,8 +18,15 @@ import at module load, so the CLI's argument errors stay fast):
   * obs.flight -- the refine-loop flight recorder: per-round
     convergence/occupancy/padding gauges plus a bounded ring buffer
     dumped on quarantine / capacity splits;
-  * obs.httpexp -- the stdlib-HTTP `/metrics` scrape endpoint
-    (`--metricsPort` on `ccs serve` and `ccs router`);
+  * obs.httpexp -- the stdlib-HTTP `/metrics` + `/healthz` scrape
+    endpoint (`--metricsPort` on `ccs serve` and `ccs router`; healthz
+    tracks the engine/router `accepting` flag through a drain);
+  * obs.ledger -- the performance ledger: schema-versioned NDJSON
+    per-run perf records with per-field tolerance classes
+    (`--perfLedger`; tools/perf_gate.py is the regression sentinel
+    defending PERF_BASELINE.json, REG011 drift-checks the schema);
+  * obs.console -- `ccs top`, the live plain-terminal fleet console
+    over the status verb + the federated exposition;
   * obs.profiling -- the opt-in jax.profiler capture hook
     (`--profile-dir`).
 

@@ -9,11 +9,16 @@ thread serving
                    (`ccs serve` renders its process registry; `ccs
                    router` renders the FEDERATED fleet exposition, so
                    one scrape target sees every replica)
-    GET /healthz   200 "ok" -- a liveness probe that costs no scrape
+    GET /healthz   liveness + readiness: 200 "ok" while the health
+                   callback (engine/router `accepting`) says yes,
+                   503 "draining" once it says no -- a load balancer
+                   sees a draining replica before its socket closes
 
 No dependencies, no TLS (the multi-tenant edge is ROADMAP item 4); bind
 it to loopback or a private interface.  Render errors return 500 with
-the error text rather than killing the serving thread.
+the error text rather than killing the serving thread, and a scrape
+racing server shutdown gets a connection error on its own socket, never
+a traceback out of the server.
 """
 
 from __future__ import annotations
@@ -27,11 +32,20 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     # set per-server via functools.partial-style subclassing in
     # start_metrics_http; annotated here for clarity
     render: Callable[[], str]
+    health: Callable[[], bool] | None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         if self.path.split("?", 1)[0] == "/healthz":
-            body = b"ok\n"
-            self.send_response(200)
+            # the health callback keeps /healthz honest during a drain:
+            # the engine stops accepting before its socket ever closes,
+            # and the probe must say so.  A raising callback reads as
+            # not-healthy (a dying process must not probe "ok").
+            try:
+                ok = self.health is None or bool(type(self).health())
+            except Exception:  # noqa: BLE001 -- see comment above
+                ok = False
+            body = b"ok\n" if ok else b"draining\n"
+            self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
         elif self.path.split("?", 1)[0] == "/metrics":
             try:
@@ -52,17 +66,30 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def handle_one_request(self):
+        try:
+            super().handle_one_request()
+        except (OSError, ValueError):
+            # a request racing server shutdown (listening socket closed,
+            # fd torn down mid-reply) fails ITS connection only -- the
+            # client sees a reset, the serving thread never tracebacks
+            self.close_connection = True
+
     def log_message(self, fmt, *args):  # scrapes are not log traffic
         pass
 
 
 def start_metrics_http(render: Callable[[], str], host: str = "127.0.0.1",
-                       port: int = 0):
+                       port: int = 0,
+                       health: Callable[[], bool] | None = None):
     """Serve `render()` on GET /metrics in a daemon thread; returns the
     started server (``.server_port`` carries the bound port for port=0,
-    ``.shutdown()`` stops it)."""
+    ``.shutdown()`` stops it).  `health` (optional) backs /healthz:
+    True -> 200 "ok", False/raise -> 503 "draining"."""
     handler = type("MetricsHandler", (_Handler,),
-                   {"render": staticmethod(render)})
+                   {"render": staticmethod(render),
+                    "health": staticmethod(health) if health is not None
+                    else None})
     server = http.server.ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
     threading.Thread(target=server.serve_forever, daemon=True,

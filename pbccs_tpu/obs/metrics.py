@@ -387,7 +387,47 @@ def _escape(v: str) -> str:
 # whole fleet.  Text-level on purpose -- the router must not need the
 # replica's registry objects, only its `metrics` verb reply.
 
-_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{([^}]*)\})?\s+(.+)$")
+# label VALUES may contain any character (escaped `\\`, `\"`, `\n` --
+# and a literal `}` or `,` needs no escape at all in the Prometheus
+# text format), so the label block must be matched quote-aware: a
+# naive [^}]* stops at the first `}` inside a value and the relabel/
+# merge helpers would corrupt or drop that series
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)'
+    r'(\{((?:[^{}"]|"(?:\\.|[^"\\])*")*)\})?\s+(.+)$')
+
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _unescape(v: str) -> str:
+    # left-to-right, single pass: sequential str.replace would corrupt
+    # values like `\\n` (escaped backslash + literal n)
+    return re.sub(r"\\(.)",
+                  lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def parse_exposition(text: str) -> dict[tuple[str, tuple[tuple[str, str],
+                                                         ...]], float]:
+    """Parse a Prometheus text exposition into {(name, label tuple):
+    value} (comment lines skipped, unparseable samples skipped).  The
+    inverse of render_prometheus for scalar samples -- what `ccs top`
+    and the federation tests read fleet figures back out of."""
+    out: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, _, inner, value = m.groups()
+        try:
+            val = float(value.split()[0])
+        except (ValueError, IndexError):
+            continue
+        labels = tuple(sorted(
+            (k, _unescape(v)) for k, v in _LABEL_RE.findall(inner or "")))
+        out[(name, labels)] = val
+    return out
 
 
 def relabel_exposition(text: str, **labels: str) -> str:
@@ -461,7 +501,10 @@ def histogram_quantile(counts: "tuple[int, ...]",
     reports the last finite bound -- a floor, honestly labeled by the
     caller); NaN when empty.  Used for the status verb's SLO block."""
     total = sum(counts)
-    if total == 0:
+    if total == 0 or not bounds:
+        # empty histogram (or degenerate: every observation in the
+        # implicit +Inf bucket with no finite bound to report) -- NaN,
+        # never an IndexError mid-scrape
         return float("nan")
     rank = q * total
     cum = 0

@@ -40,6 +40,14 @@ ADAPTER_AFTER = 2
 
 _reg = default_registry()
 
+# every entry into the shared batch-polish core (offline driver, sched
+# executor, serve flush, quarantine/OOM sub-dispatches re-enter): the
+# kernel-invocation count the perf ledger records and the regression
+# sentinel gates as a CPU-deterministic counter
+_m_polish_dispatches = _reg.counter(
+    "ccs_polish_dispatches_total",
+    "polish_prepared_batch dispatches (incl. sub-dispatch re-entries)")
+
 
 def record_zmw_failure(stage: str, exc: BaseException,
                        zmw: str | None = None) -> None:
@@ -763,6 +771,7 @@ def polish_prepared_batch(preps: Sequence[PreparedZmw],
     bucket are pre-split at admission instead of re-discovering the
     OOM."""
     settings = settings or ConsensusSettings()
+    _m_polish_dispatches.inc()
     if settings.model == "quiver":
         # Quiver has no lockstep batch driver: it polishes per ZMW (its
         # scorer batches fills internally), with the same fault isolation

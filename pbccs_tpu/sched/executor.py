@@ -48,6 +48,14 @@ _m_stages = {stage: _reg.histogram(
     "(prepare, dispatch wait, polish)",
     buckets=log_buckets(1e-4, 600.0), stage=stage)
     for stage in ("prepare", "dispatch", "polish")}
+# batches the scheduled pipeline submitted to the device pool -- a
+# CPU-deterministic perf-ledger counter (obs/ledger.py), distinct from
+# ccs_sched_tasks_total{device} whose device attribution is
+# routing-dependent
+_m_batches = _reg.counter(
+    "ccs_sched_batches_total",
+    "Prepared batches submitted to the device pool by the scheduled "
+    "pipeline")
 
 
 class ScheduledPipeline:
@@ -207,6 +215,7 @@ class ScheduledPipeline:
 
                 from pbccs_tpu.resilience import resources
 
+                _m_batches.inc()
                 self.pool.submit(
                     key, polish, zmws=len(preps),
                     capacity_bucket=resources.shape_bucket(imax, jmax, r),

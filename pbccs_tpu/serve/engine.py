@@ -38,6 +38,10 @@ import threading
 import time
 from typing import Callable, Sequence
 
+from pbccs_tpu.obs import flight as _obs_flight  # noqa: F401 -- import
+# registers the refine-loop gauges, so an idle replica's exposition
+# still carries ccs_refine_* series (zeroes) and `ccs top` renders a
+# uniform per-replica surface instead of nulls until first traffic
 from pbccs_tpu.obs import trace as obs_trace
 from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.pipeline import (
@@ -176,6 +180,13 @@ class ServeConfig:
     # than this count into ccs_slo_violations_total (burn-rate
     # numerator) and the status verb's `slo` block.  0 disables.
     slo_p99_ms: float = 0.0
+    # ---- performance ledger (obs.ledger) ----
+    # append schema-versioned NDJSON perf records to this path
+    # (--perfLedger): one snapshot every perf_ledger_interval_s plus a
+    # final one at close, and the status verb grows a `perf` block the
+    # router federates fleet-wide.  None disables.
+    perf_ledger_path: str | None = None
+    perf_ledger_interval_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -252,6 +263,11 @@ class CcsEngine:
         self._complete_thread = None
         self._n_polish_workers = 0   # set by start(); close() must not
         # depend on attributes a failed start() never assigned
+        # performance ledger (obs.ledger): periodic snapshot records
+        # while serving + a final record at close
+        self._ledger = None
+        self._ledger_stop = threading.Event()
+        self._ledger_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -313,6 +329,19 @@ class CcsEngine:
         self._polish_queue: queue.Queue[Batch | None] = queue.Queue()
         for t in self._threads:
             t.start()
+        if self.config.perf_ledger_path:
+            from pbccs_tpu.obs.ledger import PerfLedger
+
+            ledger = PerfLedger(self.config.perf_ledger_path,
+                                logger=self._log)
+            ledger_thread = threading.Thread(
+                target=self._ledger_worker, args=(ledger,), daemon=True,
+                name="ccs-serve-ledger")
+            self._ledger_stop.clear()
+            with self._lock:
+                self._ledger = ledger
+                self._ledger_thread = ledger_thread
+            ledger_thread.start()
         self._log.info(
             f"ccs engine up: max_batch={self.config.max_batch} "
             f"max_wait={self.config.max_wait_ms}ms "
@@ -412,6 +441,19 @@ class CcsEngine:
                     leftovers.append(req)
             for req in leftovers:
                 self._complete_error(req, "engine closed")
+        # performance ledger: stop the snapshot loop, then one FINAL
+        # record so a short-lived engine still leaves a run record
+        with self._lock:
+            ledger = self._ledger
+            ledger_thread = self._ledger_thread
+            self._ledger = None
+            self._ledger_thread = None
+        if ledger is not None:
+            self._ledger_stop.set()
+            if ledger_thread is not None:
+                ledger_thread.join(timeout=10.0)
+            ledger.append(self._ledger_record())
+            ledger.close()
         self.trace_stop()  # never leak a live capture past the engine
         self._log.info("ccs engine down")
         return drained
@@ -789,7 +831,48 @@ class CcsEngine:
         self._log.warn(f"request {req.chunk.id}: {message}")
         self._finish(req)
 
+    # ------------------------------------------------ performance ledger
+
+    def _ledger_record(self) -> dict:
+        """One serve-snapshot ledger record: registry deltas over the
+        engine's own measurement window plus the live serving state."""
+        from pbccs_tpu.obs import ledger as obs_ledger
+
+        with self._lock:
+            pending = self._pending
+            in_flight = self._in_flight_zmws
+            completed = self._completed
+            errors = self._errors
+        return obs_ledger.run_record(
+            self._window, kind="serve_snapshot", source="ccs-serve",
+            extra={
+                "uptime_s": round(time.monotonic() - self._start_t, 3),
+                "pending": pending,
+                "in_flight_zmws": in_flight,
+                "completed": completed,
+                "errors": errors,
+                "queue_depth": max(0, pending - in_flight),
+                "slo_requests": int(_m_slo_requests.value),
+                "slo_violations": int(_m_slo_violations.value),
+            })
+
+    def _ledger_worker(self, ledger) -> None:
+        interval = max(self.config.perf_ledger_interval_s, 0.1)
+        while not self._ledger_stop.wait(interval):
+            try:
+                ledger.append(self._ledger_record())
+            except Exception as e:  # noqa: BLE001 -- the ledger must
+                # never take the engine down (a failing append already
+                # disabled itself with a counted warning)
+                self._log.debug(f"perf ledger snapshot failed: {e!r}")
+
     # ---------------------------------------- status / metrics / trace
+
+    def accepting(self) -> bool:
+        """Cheap liveness for /healthz: False once close() began (the
+        same figure the status verb reports)."""
+        with self._lock:
+            return not self._closed
 
     def status(self) -> dict:
         """Engine introspection for the protocol's `status` verb.  Stage
@@ -811,12 +894,18 @@ class CcsEngine:
                 in_flight_zmws=self._in_flight_zmws,
             )
             pool = self._pool   # close() nulls this under the same lock
+            ledger = self._ledger
         stage_s = {k: round(v, 4)
                    for k, v in timing.stage_seconds(self._window).items()}
         sched = {"sched": pool.status()} if pool is not None else {}
+        # the status verb's perf block (protocol.FIELD_PERF): present
+        # only when this process writes a ledger, federated fleet-wide
+        # by `ccs router --perfLedger`
+        perf = {"perf": ledger.perf_block()} if ledger is not None else {}
         return {
             "engine": "ccs-serve",
             **sched,
+            **perf,
             "slo": self._slo_block(),
             "uptime_s": round(time.monotonic() - self._start_t, 3),
             "queue_depth": max(0, snap["pending"] - snap["in_flight_zmws"]),

@@ -49,6 +49,9 @@ Server replies:
   error   {"type": "error", "id": ..., "code": "<machine code>",
            "error": "<human message>"}
   status  {"type": "status", "id": ..., ...engine.status()...}
+          -- includes a `perf` block (schema_version, records,
+          last_record: the newest performance-ledger record) when the
+          process writes a perf ledger (--perfLedger)
   metrics {"type": "metrics", "id": ...,
            "content_type": "text/plain; version=0.0.4",
            "body": "<Prometheus text exposition>"}
@@ -123,6 +126,14 @@ FIELD_TRACE = "trace"
 # the trace-context object's keys
 KEY_TRACE_ID = "trace_id"
 KEY_SPAN_ID = "span_id"
+# the status reply's performance-ledger block (obs.ledger.perf_block):
+# schema version, records appended by this process, most recent record.
+# Declared here (and in WIRE_FIELDS below) so protolint polices the
+# status addition like every other wire name.
+FIELD_PERF = "perf"
+KEY_PERF_SCHEMA = "schema_version"
+KEY_PERF_RECORDS = "records"
+KEY_PERF_LAST = "last_record"
 
 
 # ------------------------------------------------------------------ wire spec
@@ -175,6 +186,13 @@ WIRE_ERRORS = (ERR_BAD_REQUEST, ERR_OVERLOADED, ERR_CLOSED, ERR_INTERNAL)
 WIRE_FIELDS = {
     FIELD_TRACE: {"keys": (KEY_TRACE_ID, KEY_SPAN_ID),
                   "verbs": (VERB_SUBMIT,)},
+    # rides the STATUS exchange: the reply to a `status` verb carries a
+    # `perf` block when the serving process writes a performance ledger
+    # (--perfLedger); absent otherwise.  The router federates these
+    # blocks fleet-wide into its own ledger.
+    FIELD_PERF: {"keys": (KEY_PERF_SCHEMA, KEY_PERF_RECORDS,
+                          KEY_PERF_LAST),
+                 "verbs": (VERB_STATUS,)},
 }
 
 

@@ -107,6 +107,14 @@ class RouterConfig:
     max_line_bytes: int = 8 << 20
     max_inflight_per_session: int = 64
     idle_timeout_s: float = 600.0
+    # ---- performance ledger (obs.ledger) ----
+    # append fleet-wide NDJSON perf records to this path (--perfLedger):
+    # every interval the router records its own snapshot plus one
+    # replica_snapshot per reachable replica (that replica's own ledger
+    # block when it writes one, else a live-state record from its
+    # status reply) -- the fleet-wide ledger merge.  None disables.
+    perf_ledger_path: str | None = None
+    perf_ledger_interval_s: float = 30.0
 
     def __post_init__(self):
         if self.bench_after < 1:
@@ -344,6 +352,10 @@ class CcsRouter:
         self._health_thread: threading.Thread | None = None
         self._emit_queue: queue.Queue | None = None
         self._emit_thread: threading.Thread | None = None
+        # fleet-wide performance ledger (config.perf_ledger_path)
+        self._ledger = None
+        self._ledger_window = None
+        self._ledger_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -369,6 +381,20 @@ class CcsRouter:
             self._health_thread = health_thread
         emit_thread.start()
         health_thread.start()
+        if self.config.perf_ledger_path:
+            from pbccs_tpu.obs.ledger import PerfLedger
+            from pbccs_tpu.runtime import timing
+
+            ledger = PerfLedger(self.config.perf_ledger_path,
+                                logger=self._log)
+            ledger_thread = threading.Thread(
+                target=self._ledger_loop, args=(ledger,), daemon=True,
+                name="ccs-router-ledger")
+            with self._lock:
+                self._ledger = ledger
+                self._ledger_window = timing.window()
+                self._ledger_thread = ledger_thread
+            ledger_thread.start()
         up = sum(1 for r in self._replicas if r.link is not None)
         self._log.info(
             f"ccs router up: {len(self._replicas)} replica(s) "
@@ -417,6 +443,22 @@ class CcsRouter:
             self._health_thread = None
         if health_thread is not None:
             health_thread.join(timeout=10.0)
+        # fleet ledger: stop the loop, take one FINAL merged snapshot
+        # while the replica links still exist (they close just below)
+        with self._lock:
+            ledger = self._ledger
+            ledger_thread = self._ledger_thread
+            self._ledger = None
+            self._ledger_thread = None
+        if ledger_thread is not None:
+            ledger_thread.join(timeout=10.0)
+        if ledger is not None:
+            try:
+                self._append_fleet_records(ledger, timeout_s=2.0)
+            except Exception as e:  # noqa: BLE001 -- the ledger must
+                # never block or break shutdown
+                self._log.debug(f"final fleet ledger tick failed: {e!r}")
+            ledger.close()
         with self._lock:
             self._down = True
             leftovers = [r for r in self._requests.values() if not r.done]
@@ -961,7 +1003,68 @@ class CcsRouter:
                     if isinstance(msg.get("trace"), dict)}
         return {"trace": cap.to_chrome(), "replicas": replicas}
 
+    # --------------------------------------------- fleet perf ledger
+
+    def _ledger_loop(self, ledger) -> None:
+        interval = max(self.config.perf_ledger_interval_s, 0.1)
+        while not self._stop.wait(interval):
+            try:
+                self._append_fleet_records(ledger)
+            except Exception as e:  # noqa: BLE001 -- observability must
+                # degrade, never take the router down
+                self._log.debug(f"fleet ledger tick failed: {e!r}")
+
+    def _append_fleet_records(self, ledger, timeout_s: float = 5.0) -> None:
+        """One fleet ledger tick: the router's own snapshot plus one
+        replica_snapshot per reachable replica.  A replica that writes
+        its own ledger contributes its newest record (the status verb's
+        `perf` block); one that does not contributes a live-state record
+        from its status reply.  Unreachable replicas are absent."""
+        from pbccs_tpu.obs import ledger as obs_ledger
+
+        with self._lock:
+            window = self._ledger_window
+            pending = len(self._requests)
+            completed = self._completed_total
+        if window is not None:
+            ledger.append(obs_ledger.run_record(
+                window, kind="router_snapshot", source="ccs-router",
+                extra={
+                    "uptime_s": round(time.monotonic() - self._start_t, 3),
+                    "pending": pending,
+                    "completed": completed,
+                }))
+        replies = self._fleet_call({"verb": protocol.VERB_STATUS},
+                                   timeout_s=timeout_s)
+        for name, msg in sorted(replies.items()):
+            perf = msg.get(protocol.FIELD_PERF)
+            last = (perf or {}).get(protocol.KEY_PERF_LAST) \
+                if isinstance(perf, dict) else None
+            if isinstance(last, dict):
+                rec = {k: v for k, v in last.items()
+                       if k in obs_ledger.LEDGER_FIELDS
+                       and k not in ("schema_version", "t_unix")}
+            else:
+                rec = {}
+            rec.update(kind="replica_snapshot", source="ccs-router",
+                       replica=name)
+            for wire_key, field in (("pending", "pending"),
+                                    ("completed", "completed"),
+                                    ("errors", "errors"),
+                                    ("in_flight_zmws", "in_flight_zmws"),
+                                    ("uptime_s", "uptime_s"),
+                                    ("queue_depth", "queue_depth")):
+                v = msg.get(wire_key)
+                if isinstance(v, (int, float)):
+                    rec[field] = v
+            ledger.append(rec)
+
     # ------------------------------------------- status / metrics (session)
+
+    def accepting(self) -> bool:
+        """Cheap liveness for /healthz: False once a drain began."""
+        with self._lock:
+            return self._accepting
 
     def status(self) -> dict:
         with self._lock:
@@ -975,8 +1078,12 @@ class CcsRouter:
                 "routed": r.routed,
                 "failovers": r.failovers,
             } for r in self._replicas]
+            ledger = self._ledger
+            perf = {protocol.FIELD_PERF: ledger.perf_block()} \
+                if ledger is not None else {}
             return {
                 "engine": "ccs-router",
+                **perf,
                 "accepting": self._accepting,
                 "uptime_s": round(time.monotonic() - self._start_t, 3),
                 "pending": len(self._requests),
@@ -1137,6 +1244,17 @@ def build_router_parser() -> argparse.ArgumentParser:
                         "stdlib-HTTP /metrics endpoint (-1 = ephemeral, "
                         "printed as CCS-METRICS-READY; 0 disables). "
                         "Default = %(default)s")
+    p.add_argument("--perfLedger", default=None, metavar="PATH",
+                   help="Append the FLEET-WIDE performance ledger to "
+                        "PATH: per interval, the router's own snapshot "
+                        "plus one replica_snapshot per reachable "
+                        "replica (its own ledger record when it runs "
+                        "--perfLedger, else its live status figures). "
+                        "Default: off.")
+    p.add_argument("--perfLedgerInterval", type=float,
+                   default=defaults.perf_ledger_interval_s,
+                   help="Seconds between fleet ledger ticks. "
+                        "Default = %(default)s")
     p.add_argument("--logLevel", default="INFO")
     return p
 
@@ -1154,7 +1272,9 @@ def run_router(argv: list[str] | None = None) -> int:
             spill_depth=args.routerSpillDepth,
             max_line_bytes=args.maxLineBytes,
             max_inflight_per_session=args.maxInflightPerSession,
-            idle_timeout_s=args.idleTimeout)
+            idle_timeout_s=args.idleTimeout,
+            perf_ledger_path=args.perfLedger,
+            perf_ledger_interval_s=args.perfLedgerInterval)
         router = CcsRouter(args.replica, config, logger=log)
     except ValueError as e:
         # a knob or replica spec the dataclass/router rejected: a clean
@@ -1167,7 +1287,8 @@ def run_router(argv: list[str] | None = None) -> int:
         from pbccs_tpu.serve.server import start_metrics_endpoint
 
         metrics_http = start_metrics_endpoint(
-            args.metricsPort, router.metrics_text, args.host, log)
+            args.metricsPort, router.metrics_text, args.host, log,
+            health=router.accepting)
         # machine-readable ready line for wrappers (mirrors CCS-SERVE-READY)
         print(f"CCS-ROUTER-READY {server.host} {server.port}", flush=True)
 

@@ -523,6 +523,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "requests count into ccs_slo_violations_total "
                         "and the status verb's slo block (0 disables). "
                         "Default = %(default)s")
+    p.add_argument("--perfLedger", default=None, metavar="PATH",
+                   help="Append schema-versioned NDJSON performance "
+                        "records (obs/ledger.py) to PATH: one snapshot "
+                        "per --perfLedgerInterval plus a final record "
+                        "at drain; the status verb grows a `perf` "
+                        "block the router federates.  Default: off.")
+    p.add_argument("--perfLedgerInterval", type=float,
+                   default=defaults.perf_ledger_interval_s,
+                   help="Seconds between perf-ledger snapshots. "
+                        "Default = %(default)s")
     p.add_argument("--compileCache", default=None, metavar="DIR",
                    help="Persistent XLA compilation-cache directory "
                         "shared across replicas/restarts: a rolling "
@@ -576,13 +586,16 @@ def run_serve(argv: list[str] | None = None) -> int:
         max_line_bytes=args.maxLineBytes,
         max_inflight_per_session=args.maxInflightPerSession,
         idle_timeout_s=args.idleTimeout,
-        slo_p99_ms=args.sloP99Ms)
+        slo_p99_ms=args.sloP99Ms,
+        perf_ledger_path=args.perfLedger,
+        perf_ledger_interval_s=args.perfLedgerInterval)
 
     with CcsEngine(settings, config, logger=log) as engine:
         server = CcsServer(engine, args.host, args.port, logger=log)
         server.start()
         metrics_http = start_metrics_endpoint(
-            args.metricsPort, engine.metrics_text, args.host, log)
+            args.metricsPort, engine.metrics_text, args.host, log,
+            health=engine.accepting)
         # machine-readable ready line for wrappers (serve_bench polls it)
         print(f"CCS-SERVE-READY {server.host} {server.port}", flush=True)
 
@@ -620,17 +633,21 @@ def run_serve(argv: list[str] | None = None) -> int:
     return 0
 
 
-def start_metrics_endpoint(port: int, render, host: str, log):
+def start_metrics_endpoint(port: int, render, host: str, log,
+                           health=None):
     """Shared `--metricsPort` wiring for `ccs serve` and `ccs router`:
     0 disables, -1 binds an ephemeral port; the bound port is printed as
     a machine-readable CCS-METRICS-READY line (wrappers/smokes poll it,
-    mirroring CCS-SERVE-READY)."""
+    mirroring CCS-SERVE-READY).  `health` backs /healthz (engine/router
+    `accepting`), so a draining process probes 503 before its socket
+    ever closes."""
     if port == 0:
         return None
     from pbccs_tpu.obs.httpexp import start_metrics_http
 
     server = start_metrics_http(render, host=host,
-                                port=0 if port < 0 else port)
+                                port=0 if port < 0 else port,
+                                health=health)
     print(f"CCS-METRICS-READY {host} {server.server_port}", flush=True)
     log.info(f"metrics scrape endpoint on "
              f"http://{host}:{server.server_port}/metrics")

@@ -30,4 +30,5 @@ AST_CASES = {
 # the matching DESIGN.md table and asserts fire/quiet there.
 REPO_CASES = {
     "REG010": ("reg010_pos.py", "reg010_neg.py"),
+    "REG011": ("reg011_pos.py", "reg011_neg.py"),
 }

@@ -1,0 +1,12 @@
+"""REG011 negative: every declared perf-ledger field matches the
+constructed mini repo's DESIGN.md ledger-schema table (name AND class),
+and a non-schema dict named something else never counts."""
+
+LEDGER_FIELDS = {
+    "reg011_documented": "meta",
+    "reg011_shifty": "wall",
+}
+
+OTHER_FIELDS = {
+    "not_a_ledger_field": "whatever",
+}

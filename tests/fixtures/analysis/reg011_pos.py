@@ -1,0 +1,10 @@
+"""REG011 positive: declares a perf-ledger field the constructed mini
+repo's DESIGN.md ledger-schema table does not list (`reg011_alien`),
+plus a field whose tolerance CLASS disagrees with the table
+(`reg011_shifty` is `counter` here but `wall` in the table)."""
+
+LEDGER_FIELDS = {
+    "reg011_documented": "meta",
+    "reg011_shifty": "counter",
+    "reg011_alien": "ratio",
+}

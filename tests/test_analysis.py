@@ -228,6 +228,57 @@ def test_reg010_quiet_on_negative_fixture(tmp_path):
     assert found == [], found
 
 
+def _ledger_repo(tmp_path: pathlib.Path, fixture: str) -> pathlib.Path:
+    """Mini repo for the REG011 fixtures: the fixture file under
+    pbccs_tpu/ plus a DESIGN.md ledger-schema table listing
+    `reg011_documented` (meta) and `reg011_shifty` (wall) only."""
+    pkg = tmp_path / "pbccs_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text((FIXTURES / fixture).read_text())
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "DESIGN.md").write_text(textwrap.dedent("""\
+        <!-- ccs-analyze:ledger-schema-table:begin -->
+        | field | class | source |
+        |---|---|---|
+        | `reg011_documented` | meta | `pbccs_tpu/mod.py` |
+        | `reg011_shifty` | wall | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:ledger-schema-table:end -->
+    """))
+    return tmp_path
+
+
+def test_reg011_fires_on_positive_fixture(tmp_path):
+    pos, _neg = REPO_CASES["REG011"]
+    root = _ledger_repo(tmp_path, pos)
+    found = [f for f in run_passes(root) if f.rule == "REG011"]
+    # undeclared field direction
+    assert any("reg011_alien" in f.message for f in found), found
+    # class-mismatch direction (counter in code, wall in the table)
+    assert any("reg011_shifty" in f.message and "class" in f.message
+               for f in found), found
+
+
+def test_reg011_table_side_ghost_row_fires(tmp_path):
+    _pos, neg = REPO_CASES["REG011"]
+    root = _ledger_repo(tmp_path, neg)
+    design = root / "docs" / "DESIGN.md"
+    design.write_text(design.read_text().replace(
+        "<!-- ccs-analyze:ledger-schema-table:end -->",
+        "| `reg011_ghost` | counter | `pbccs_tpu/mod.py` |\n"
+        "<!-- ccs-analyze:ledger-schema-table:end -->"))
+    found = [f for f in run_passes(root) if f.rule == "REG011"]
+    assert any("reg011_ghost" in f.message
+               and f.path == "docs/DESIGN.md" for f in found), found
+
+
+def test_reg011_quiet_on_negative_fixture(tmp_path):
+    _pos, neg = REPO_CASES["REG011"]
+    root = _ledger_repo(tmp_path, neg)
+    found = [f for f in run_passes(root) if f.rule == "REG011"]
+    assert found == [], found
+
+
 def test_metric_kind_mismatch_is_drift(tmp_path):
     root = _mini_repo(tmp_path)
     (root / "docs" / "DESIGN.md").write_text(textwrap.dedent("""\

@@ -525,6 +525,212 @@ class TestFederationHelpers:
         import math
         assert math.isnan(histogram_quantile((0, 0, 0, 0), bounds, 0.5))
 
+    def test_histogram_quantile_edge_shapes(self):
+        """Empty/all-zero counts, a single bucket, and degenerate
+        no-finite-bounds layouts answer (NaN or a bound), never raise."""
+        import math
+
+        from pbccs_tpu.obs.metrics import histogram_quantile
+
+        # empty layouts: no counts at all / no finite bounds
+        assert math.isnan(histogram_quantile((), (), 0.5))
+        assert math.isnan(histogram_quantile((5,), (), 0.9))
+        # all-zero counts at every width
+        assert math.isnan(histogram_quantile((0,), (), 0.5))
+        assert math.isnan(histogram_quantile((0, 0), (1.0,), 0.5))
+        # a single bucket: everything lands on its one bound
+        assert histogram_quantile((3, 0), (1.0,), 0.01) == 1.0
+        assert histogram_quantile((3, 0), (1.0,), 0.99) == 1.0
+        # overflow-only observations report the last finite bound
+        assert histogram_quantile((0, 7), (1.0,), 0.5) == 1.0
+        # q=0 and q=1 extremes stay in range
+        assert histogram_quantile((1, 1, 0), (0.1, 0.2), 0.0) == 0.1
+        assert histogram_quantile((1, 1, 0), (0.1, 0.2), 1.0) == 0.2
+
+    def test_hostile_label_values_roundtrip_federation(self):
+        """Label values containing backslash, quote, newline, and a
+        literal `}` must survive render -> relabel -> merge -> parse
+        without corrupting the exposition (the values the fleet mints
+        from network identity are not this hostile; a chaos test's
+        are)."""
+        from pbccs_tpu.obs.metrics import (MetricsRegistry,
+                                           merge_expositions,
+                                           parse_exposition,
+                                           relabel_exposition)
+
+        hostile = 'a\\b"c}d\ne'
+        reg = MetricsRegistry()
+        reg.counter("ccs_hostile_total", "t", path=hostile).inc(3)
+        body = reg.render_prometheus()
+        relabeled = relabel_exposition(body, replica="r:1")
+        merged = merge_expositions([relabeled])
+        parsed = parse_exposition(merged)
+        key = ("ccs_hostile_total",
+               (("path", hostile), ("replica", "r:1")))
+        assert parsed[key] == 3.0
+        # the relabel actually landed (a corrupted line would have been
+        # passed through unlabeled)
+        assert all("replica" in dict(labels)
+                   for (_n, labels) in parsed)
+
+    def test_relabel_escapes_injected_label_value(self):
+        from pbccs_tpu.obs.metrics import (parse_exposition,
+                                           relabel_exposition)
+
+        out = relabel_exposition("a_total 1\n", replica='x"y\\z')
+        assert parse_exposition(out)[
+            ("a_total", (("replica", 'x"y\\z'),))] == 1.0
+
+    def test_merge_empty_and_comment_only_parts(self):
+        from pbccs_tpu.obs.metrics import merge_expositions
+
+        assert merge_expositions([]) == ""
+        assert merge_expositions(["", "# HELP x_total h\n"]) == ""
+        merged = merge_expositions(["", "# TYPE a_total counter\n"
+                                        "a_total 1\n"])
+        assert "a_total 1" in merged
+
+
+class TestHttpExposition:
+    """obs/httpexp.py error paths: 404 on unknown paths, a scrape
+    racing server shutdown degrades to a connection error (never a
+    handler traceback), and /healthz tracks the health callback
+    through an engine drain."""
+
+    @staticmethod
+    def _stop(server):
+        # shutdown() only stops serve_forever; server_close() releases
+        # the listening socket so later connects fail fast and tests
+        # don't leak fds for the process lifetime
+        server.shutdown()
+        server.server_close()
+
+    @staticmethod
+    def _get(port, path, timeout=5.0):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def test_unknown_path_is_404(self):
+        from pbccs_tpu.obs.httpexp import start_metrics_http
+
+        server = start_metrics_http(lambda: "x 1\n")
+        try:
+            status, body = self._get(server.server_port, "/nope")
+            assert status == 404 and b"not found" in body
+            status, _ = self._get(server.server_port,
+                                  "/metrics/../../etc/passwd")
+            assert status == 404
+        finally:
+            self._stop(server)
+
+    def test_render_error_is_500_and_server_survives(self):
+        from pbccs_tpu.obs.httpexp import start_metrics_http
+
+        calls = [0]
+
+        def render():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise RuntimeError("boom")
+            return "ok_total 1\n"
+
+        server = start_metrics_http(render)
+        try:
+            status, body = self._get(server.server_port, "/metrics")
+            assert status == 500 and b"boom" in body
+            status, body = self._get(server.server_port, "/metrics")
+            assert status == 200 and b"ok_total" in body
+        finally:
+            self._stop(server)
+
+    def test_healthz_tracks_health_callback(self):
+        from pbccs_tpu.obs.httpexp import start_metrics_http
+
+        healthy = [True]
+        server = start_metrics_http(lambda: "x 1\n",
+                                    health=lambda: healthy[0])
+        try:
+            status, body = self._get(server.server_port, "/healthz")
+            assert status == 200 and body == b"ok\n"
+            healthy[0] = False
+            status, body = self._get(server.server_port, "/healthz")
+            assert status == 503 and body == b"draining\n"
+            # a RAISING health callback reads as unhealthy, not a 500
+            server2 = start_metrics_http(
+                lambda: "x 1\n",
+                health=lambda: (_ for _ in ()).throw(RuntimeError()))
+            try:
+                status, _ = self._get(server2.server_port, "/healthz")
+                assert status == 503
+            finally:
+                self._stop(server2)
+        finally:
+            self._stop(server)
+
+    def test_healthz_accurate_during_engine_drain(self):
+        import numpy as np
+
+        from pbccs_tpu.obs.httpexp import start_metrics_http
+        from pbccs_tpu.pipeline import Failure, PreparedZmw
+        from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
+
+        eng = CcsEngine(
+            config=ServeConfig(max_batch=1, max_wait_ms=20.0),
+            prep_fn=lambda c, s: (None, PreparedZmw(
+                c, np.zeros(8, np.int8), [], 1, 0, 0.0)),
+            polish_fn=lambda p, s: [(Failure.SUCCESS, None)
+                                    for _ in p]).start()
+        server = start_metrics_http(eng.metrics_text,
+                                    health=eng.accepting)
+        try:
+            assert self._get(server.server_port, "/healthz")[0] == 200
+            eng.close()   # drain begins: accepting flips false
+            assert self._get(server.server_port, "/healthz")[0] == 503
+        finally:
+            self._stop(server)
+
+    def test_scrape_racing_shutdown_degrades(self):
+        """Scrapes fired while the server shuts down either answer or
+        fail THEIR socket; none leaves the server wedged and the port
+        is dead afterwards."""
+        import threading
+
+        from pbccs_tpu.obs.httpexp import start_metrics_http
+
+        server = start_metrics_http(lambda: "x 1\n" * 200)
+        port = server.server_port
+        outcomes = []
+
+        def scrape():
+            try:
+                outcomes.append(self._get(port, "/metrics",
+                                          timeout=2.0)[0])
+            except Exception:  # noqa: BLE001 -- any transport-level
+                # failure (reset, torn reply, timeout) is the expected
+                # degradation; a traceback OUT of the server is not
+                outcomes.append("conn_error")
+
+        threads = [threading.Thread(target=scrape) for _ in range(8)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 3:
+                self._stop(server)
+        for t in threads:
+            t.join(timeout=5.0)
+        assert len(outcomes) == 8
+        assert all(o in (200, "conn_error") for o in outcomes), outcomes
+        import pytest as _pytest
+        with _pytest.raises(OSError):
+            self._get(port, "/metrics", timeout=1.0)
+
 
 class TestFlightRecorder:
     def test_ring_bounds_and_gauges(self):

@@ -1,0 +1,135 @@
+"""tools/trace_merge.py hardening: empty bundles, zero-span replicas,
+missing wall-clock origins, and alien event shapes must merge with a
+note -- never a KeyError mid-merge (the fleet smoke feeds this tool
+real trace-stop bundles; chaos feeds it torn ones)."""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import trace_merge  # noqa: E402  (tools/ module, path-injected above)
+
+
+def chrome(events, origin=None, **meta):
+    doc = {"traceEvents": events, "meta": dict(meta)}
+    if origin is not None:
+        doc["meta"]["origin_unix"] = origin
+    return doc
+
+
+def span(name, ts, span_id=None, parent=None, remote_parent=None,
+         trace_id=None, **extra):
+    args = dict(extra)
+    if span_id is not None:
+        args["span_id"] = span_id
+    if parent is not None:
+        args["parent"] = parent
+    if remote_parent is not None:
+        args["remote_parent"] = remote_parent
+    if trace_id is not None:
+        args["trace_id"] = trace_id
+    return {"ph": "X", "name": name, "ts": ts, "dur": 5.0, "tid": 0,
+            "args": args}
+
+
+class TestMergeDegradation:
+    def test_empty_bundle_merges_to_valid_empty_doc(self):
+        merged = trace_merge.merge_docs(
+            trace_merge.expand_bundle({"replicas": {}}))
+        assert merged["traceEvents"][0]["name"] == "process_name"
+        assert merged["meta"]["processes"] == {"router": 1}
+        assert trace_merge.request_trees(merged) == {}
+
+    def test_totally_empty_input(self):
+        merged = trace_merge.merge_docs([])
+        assert merged["traceEvents"] == []
+        assert merged["meta"]["processes"] == {}
+
+    def test_zero_span_replica_merges_cleanly(self):
+        bundle = {"trace": chrome([span("a", 1.0, trace_id="t1",
+                                        span_id="s1")], origin=100.0),
+                  "replicas": {"r:1": chrome([], origin=100.5)}}
+        merged = trace_merge.merge_docs(trace_merge.expand_bundle(bundle))
+        assert merged["meta"]["processes"] == {"router": 1,
+                                               "replica r:1": 2}
+        report = trace_merge.request_trees(merged)
+        assert report["t1"]["events"] == 1
+
+    def test_missing_origin_is_noted_not_keyerror(self):
+        bundle = {"trace": chrome([span("a", 1.0)], origin=100.0),
+                  "replicas": {"r:1": chrome([span("b", 2.0)])}}
+        merged = trace_merge.merge_docs(trace_merge.expand_bundle(bundle))
+        assert merged["meta"]["unrebased_processes"] == ["replica r:1"]
+        # the unrebased process's events keep their own timebase
+        names = {ev.get("name") for ev in merged["traceEvents"]}
+        assert {"a", "b"} <= names
+
+    def test_malformed_replica_chrome_is_skipped_with_note(self):
+        bundle = {"trace": chrome([span("a", 1.0)], origin=1.0),
+                  "replicas": {"bad:1": None, "worse:2": "not a dict",
+                               "ok:3": chrome([span("c", 3.0)],
+                                              origin=1.5)}}
+        merged = trace_merge.merge_docs(trace_merge.expand_bundle(bundle))
+        assert sorted(merged["meta"]["skipped_processes"]) == [
+            "replica bad:1", "replica worse:2"]
+        assert "replica ok:3" in merged["meta"]["processes"]
+
+    def test_alien_event_shapes_never_raise(self):
+        doc = chrome([
+            {"ph": "X", "name": "no_args", "ts": 1.0},       # args absent
+            {"ph": "X", "name": "bad_args", "ts": 2.0,
+             "args": "not a dict"},
+            "not even a dict",
+            {"ph": "X", "name": "ok", "ts": 3.0,
+             "args": {"trace_id": "t", "span_id": "s"}},
+        ], origin=5.0)
+        merged = trace_merge.merge_docs([("p", doc)])
+        report = trace_merge.request_trees(merged)
+        assert report["t"]["events"] == 1
+        assert trace_merge.trace_connected(merged, "t")
+
+    def test_mixed_type_trace_ids_skip_not_typeerror(self):
+        doc = chrome([
+            span("alien", 1.0, trace_id=42),          # int id: skipped
+            span("ok", 2.0, trace_id="t1", span_id="s1"),
+        ], origin=1.0)
+        merged = trace_merge.merge_docs([("p", doc)])
+        report = trace_merge.request_trees(merged)
+        assert list(report) == ["t1"]
+        assert report["t1"]["events"] == 1
+
+    def test_alien_name_and_unhashable_id_skip_not_typeerror(self):
+        doc = chrome([
+            {"ph": "X", "name": 5, "ts": 1.0,       # non-string name
+             "args": {"span_id": "s1", "trace_id": "t1"}},
+            {"ph": "X", "name": "ok", "ts": 2.0,
+             "id": ["unhashable"],                   # alien event id
+             "args": {"trace_id": "t1", "parent": ["also"],
+                      "span_id": "s2"}},
+        ], origin=1.0)
+        merged = trace_merge.merge_docs([("p", doc)])
+        report = trace_merge.request_trees(merged)
+        assert report["t1"]["events"] == 2
+        assert report["t1"]["spans"] == ["5", "ok"]
+
+    def test_non_numeric_meta_counts_degrade(self):
+        doc = chrome([span("a", 1.0)], origin=1.0,
+                     dropped_spans="garbage", open_spans=None)
+        merged = trace_merge.merge_docs([("p", doc)])
+        assert merged["meta"]["dropped_spans"] == 0
+
+    def test_cross_process_links_still_connect_after_hardening(self):
+        bundle = {
+            "trace": chrome([span("router.request", 1.0, span_id="rt-1",
+                                  trace_id="t1")], origin=100.0),
+            "replicas": {"r:1": chrome(
+                [span("serve.prep", 2.0, span_id="sp-1",
+                      remote_parent="rt-1", trace_id="t1")],
+                origin=100.2)},
+        }
+        merged = trace_merge.merge_docs(trace_merge.expand_bundle(bundle))
+        assert trace_merge.trace_connected(merged, "t1")
+        report = trace_merge.request_trees(merged)
+        assert report["t1"]["processes"] == [1, 2]

@@ -49,6 +49,12 @@ echo "== endurance smoke (scaled full-cell stream: OOM + ENOSPC + kill -9, zero 
 # runtime); the wrapper allows cold-compile headroom
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/endurance_smoke.py || exit 1
 
+echo "== perf smoke (ledger schema + counter determinism + perf_gate vs PERF_BASELINE) =="
+# two fresh-process runs of a fixed workload: CPU-deterministic ledger
+# counters must be identical, the gate must pass the clean ledger in
+# counters-only mode and reject a perturbed one with a structured diff
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/perf_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

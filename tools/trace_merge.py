@@ -43,36 +43,74 @@ from typing import Any
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _span_args(ev: Any) -> dict:
+    """An event's args as a dict, {} for anything malformed -- the
+    merge must degrade on alien events, never KeyError mid-merge."""
+    args = ev.get("args") if isinstance(ev, dict) else None
+    return args if isinstance(args, dict) else {}
+
+
 def merge_docs(docs: list[tuple[str, dict]]) -> dict[str, Any]:
     """Merge (process_name, chrome_doc) pairs into one Chrome-trace
-    object (see module docstring for the semantics)."""
-    origins = [d.get("meta", {}).get("origin_unix")
-               for _, d in docs]
-    known = [o for o in origins if isinstance(o, (int, float))]
+    object (see module docstring for the semantics).
+
+    Degrades, never crashes: a non-dict chrome doc (a replica whose
+    trace-stop reply was malformed) is SKIPPED and named in
+    ``meta.skipped_processes``; a doc whose ``meta.origin_unix`` is
+    missing or non-numeric stays on its own (unshifted) timebase and is
+    named in ``meta.unrebased_processes``; a replica with zero spans
+    merges as an empty process row.  An empty bundle yields an empty
+    (but valid) merged doc."""
+    usable: list[tuple[str, dict]] = []
+    skipped: list[str] = []
+    unrebased: list[str] = []
+    for name, doc in docs:
+        if isinstance(doc, dict):
+            usable.append((name, doc))
+        else:
+            skipped.append(str(name))
+
+    def doc_meta(doc: dict) -> dict:
+        meta = doc.get("meta")
+        return meta if isinstance(meta, dict) else {}
+
+    origins = [doc_meta(d).get("origin_unix") for _, d in usable]
+    known = [o for o in origins if isinstance(o, (int, float))
+             and not isinstance(o, bool)]
     base = min(known) if known else 0.0
 
     events: list[dict] = []
     processes: dict[str, int] = {}
     dropped = open_spans = 0
     by_span_id: dict[str, dict] = {}
-    for i, (name, doc) in enumerate(docs):
+    for i, (name, doc) in enumerate(usable):
         pid = i + 1
         processes[name] = pid
-        meta = doc.get("meta", {})
-        dropped += int(meta.get("dropped_spans", 0) or 0)
-        open_spans += int(meta.get("open_spans", 0) or 0)
+        meta = doc_meta(doc)
+        try:
+            dropped += int(meta.get("dropped_spans", 0) or 0)
+            open_spans += int(meta.get("open_spans", 0) or 0)
+        except (TypeError, ValueError):
+            pass  # alien meta counts; the span data still merges
         origin = meta.get("origin_unix")
-        shift_us = ((origin - base) * 1e6
-                    if isinstance(origin, (int, float)) else 0.0)
+        if isinstance(origin, (int, float)) and not isinstance(origin,
+                                                               bool):
+            shift_us = (origin - base) * 1e6
+        else:
+            shift_us = 0.0
+            unrebased.append(str(name))
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": name}})
-        for ev in doc.get("traceEvents", []):
+        raw = doc.get("traceEvents")
+        for ev in (raw if isinstance(raw, list) else []):
+            if not isinstance(ev, dict):
+                continue
             ev = dict(ev)
             ev["pid"] = pid
             if isinstance(ev.get("ts"), (int, float)):
                 ev["ts"] = round(ev["ts"] + shift_us, 1)
             events.append(ev)
-            sid = ev.get("args", {}).get("span_id")
+            sid = _span_args(ev).get("span_id")
             if isinstance(sid, str):
                 by_span_id.setdefault(sid, ev)
 
@@ -80,7 +118,7 @@ def merge_docs(docs: list[tuple[str, dict]]) -> dict[str, Any]:
     flow_seq = 0
     flows: list[dict] = []
     for ev in events:
-        rp = ev.get("args", {}).get("remote_parent")
+        rp = _span_args(ev).get("remote_parent")
         if not isinstance(rp, str):
             continue
         parent = by_span_id.get(rp)
@@ -93,11 +131,17 @@ def merge_docs(docs: list[tuple[str, dict]]) -> dict[str, Any]:
                       "ts": parent.get("ts", 0)})
         flows.append({**common, "ph": "f", "bp": "e", "pid": ev["pid"],
                       "tid": ev.get("tid", 0), "ts": ev.get("ts", 0)})
+    meta: dict[str, Any] = {"processes": processes,
+                            "dropped_spans": dropped,
+                            "open_spans": open_spans}
+    if skipped:
+        meta["skipped_processes"] = sorted(skipped)
+    if unrebased:
+        meta["unrebased_processes"] = sorted(unrebased)
     return {
         "traceEvents": events + flows,
         "displayTimeUnit": "ms",
-        "meta": {"processes": processes, "dropped_spans": dropped,
-                 "open_spans": open_spans},
+        "meta": meta,
     }
 
 
@@ -107,11 +151,19 @@ def expand_bundle(obj: dict, router_name: str = "router"
     from a bare chrome doc (single-process input)."""
     if "replicas" in obj or ("trace" in obj
                              and "traceEvents" not in obj):
-        docs = [(router_name, obj.get("trace") or {"traceEvents": []})]
-        for name, chrome in sorted((obj.get("replicas") or {}).items()):
-            docs.append((f"replica {name}", chrome))
+        trace = obj.get("trace")
+        docs = [(router_name,
+                 trace if isinstance(trace, dict) else {"traceEvents": []})]
+        replicas = obj.get("replicas")
+        if isinstance(replicas, dict):
+            for name, chrome in sorted(replicas.items()):
+                # a malformed per-replica chrome rides through as-is:
+                # merge_docs skips it with a meta.skipped_processes note
+                docs.append((f"replica {name}", chrome))
         return docs
-    return [(obj.get("meta", {}).get("process", router_name), obj)]
+    meta = obj.get("meta")
+    process = meta.get("process") if isinstance(meta, dict) else None
+    return [(process or router_name, obj)]
 
 
 # ------------------------------------------------------- tree assertions
@@ -121,11 +173,20 @@ def request_trees(merged: dict) -> dict[str, dict[str, Any]]:
     {trace_id: {"events": n, "components": k, "processes": [...]}} --
     a request whose spans crossed the fleet under one trace shows
     components == 1 and len(processes) >= 2."""
+    def hashable(v) -> bool:
+        try:
+            hash(v)
+        except TypeError:
+            return False
+        return True
+
     events = [ev for ev in merged.get("traceEvents", [])
-              if ev.get("ph") == "X"]
-    by_span_id = {ev["args"]["span_id"]: ev for ev in events
-                  if isinstance(ev.get("args", {}).get("span_id"), str)}
-    by_pid_index = {(ev["pid"], ev.get("id")): ev for ev in events}
+              if isinstance(ev, dict) and ev.get("ph") == "X"
+              and "pid" in ev]
+    by_span_id = {_span_args(ev)["span_id"]: ev for ev in events
+                  if isinstance(_span_args(ev).get("span_id"), str)}
+    by_pid_index = {(ev["pid"], ev.get("id")): ev for ev in events
+                    if hashable(ev.get("id"))}
 
     parent: dict[int, int] = {}
 
@@ -140,24 +201,29 @@ def request_trees(merged: dict) -> dict[str, dict[str, Any]]:
 
     ids = {id(ev): ev for ev in events}
     for ev in events:
-        args = ev.get("args", {})
+        args = _span_args(ev)
         rp = args.get("remote_parent")
         if isinstance(rp, str) and rp in by_span_id:
             union(id(ev), id(by_span_id[rp]))
         p = args.get("parent")
-        if p is not None and (ev["pid"], p) in by_pid_index:
+        if p is not None and hashable(p) \
+                and (ev["pid"], p) in by_pid_index:
             union(id(ev), id(by_pid_index[(ev["pid"], p)]))
 
     out: dict[str, dict[str, Any]] = {}
-    for tid in sorted({ev["args"].get("trace_id") for ev in events
-                       if ev.get("args", {}).get("trace_id")}):
-        mine = [ev for ev in events if ev["args"].get("trace_id") == tid]
+    # only STRING trace ids participate: an alien-typed id (an int a
+    # malformed replica minted) must be skipped like every other alien
+    # shape, not crash the sort with a mixed-type comparison
+    tids = {_span_args(ev).get("trace_id") for ev in events}
+    for tid in sorted(t for t in tids if isinstance(t, str) and t):
+        mine = [ev for ev in events
+                if _span_args(ev).get("trace_id") == tid]
         roots = {find(id(ev)) for ev in mine}
         out[tid] = {
             "events": len(mine),
             "components": len(roots),
             "processes": sorted({ev["pid"] for ev in mine}),
-            "spans": sorted({ev["name"] for ev in mine}),
+            "spans": sorted({str(ev.get("name", "?")) for ev in mine}),
         }
     del ids
     return out
