@@ -292,7 +292,9 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
 
     if repeats is None:
         repeats = int(os.environ.get("BENCH_REPEATS", 5))
-    run_times, wait_times = [], []
+    from pbccs_tpu.obs import roofline as obs_roofline
+
+    run_times, wait_times, xla_flops_reps = [], [], []
     eval_outputs = eval_truths = None
     for rep in range(repeats):
         tasks, truths = build_tasks(rng, n_zmws, tpl_len, n_passes,
@@ -305,6 +307,10 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
         tpls, results, qvs = run_all(tasks)
         run_times.append(time.monotonic() - t0)
         wait_times.append(timing.device_wait_seconds(win))
+        # XLA-derived CostCard flops charged during THIS repeat (same
+        # window), the cross-check for the analytic model below
+        xla_flops_reps.append(int(sum(
+            win.counters(obs_roofline.FLOPS_TOTAL).values())))
         if rep == 0:
             # accuracy is scored on the FIRST timed repeat's draw: the rng
             # stream position (seed 20260729, draw #2 after warmup) is the
@@ -323,6 +329,19 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
     banding = last_pol[0].banding_report() if last_pol[0] is not None else {}
     flops = _estimate_flops(n_zmws, tpl_len, n_passes,
                             sum(r.n_tested for r in results_eval), batch_size)
+    # the hand model vs XLA's own count for the median-closest repeat: a
+    # >2x disagreement means the analytic model silently drifted from
+    # what the compiled programs actually do (it was unfalsifiable
+    # before the roofline plane existed)
+    xla_flops = xla_flops_reps[pick]
+    flops_model_note = None
+    if xla_flops and flops:
+        mismatch = max(flops / xla_flops, xla_flops / flops)
+        if mismatch > 2.0:
+            flops_model_note = (
+                f"analytic flops model disagrees with XLA CostCard "
+                f"flops by {mismatch:.1f}x (est {flops:.3e}, "
+                f"xla {xla_flops:.3e}); re-derive _estimate_flops")
     n_exact = sum(bool(np.array_equal(tpls[z], eval_truths[z]))
                   for z in range(n_zmws))
     mean_qv = float(np.mean([q.mean() for q in qvs]))
@@ -348,6 +367,13 @@ def bench(n_zmws: int, tpl_len: int, n_passes, n_corruptions: int,
         "device_wait_fraction": round(device_wait_fraction, 4),
         "est_fill_tflops": round(flops / 1e12, 4),
         "est_device_tflops_per_sec": round(flops / 1e12 / bench_s, 4),
+        # the XLA-derived pair (roofline CostCard charge over the
+        # median-closest repeat); None when no card was extractable
+        "xla_fill_tflops": float(f"{xla_flops / 1e12:.4g}")
+        if xla_flops else None,
+        "xla_device_tflops_per_sec": float(
+            f"{xla_flops / 1e12 / bench_s:.4g}") if xla_flops else None,
+        "flops_model_note": flops_model_note,
         "warmup_s": warm_s,
         "n_zmws": n_zmws,
         "tpl_len": tpl_len,

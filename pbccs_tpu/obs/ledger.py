@@ -77,6 +77,10 @@ LEDGER_FIELDS = {
     "device_wait_s": "wall",
     "device_step_ms": "wall",  # mean device fetch-to-fetch step
     "compile_s": "wall",       # warmup/compile seconds where measured
+    # roofline rates: flops-charged / refine wall (a timing, so wall
+    # class -- but also floor-gated via PERF_BASELINE.json "floors")
+    "roofline_achieved_tflops": "wall",
+    "roofline_efficiency": "wall",
     # ---- host memory (resource) ----
     "peak_rss_bytes": "resource",
     # ---- CPU-deterministic counters (exact everywhere) ----
@@ -99,6 +103,11 @@ LEDGER_FIELDS = {
     "oom_ceilings": "counter",
     "admission_presplits": "counter",
     "budget_throttles": "counter",
+    # roofline plane (obs/roofline.py): CostCard-bound work charged for
+    # executed canonical programs -- integer-scaled from the card, so
+    # deterministic wherever the card is (same jax build)
+    "roofline_flops": "counter",
+    "roofline_bytes": "counter",
     # ---- CPU-deterministic ratios/shares (absolute band everywhere) ----
     "fill_ratio_zmw": "ratio",
     "fill_ratio_read": "ratio",
@@ -363,6 +372,26 @@ def run_record(scope: MeasurementScope, *, kind: str, source: str,
         rec["fill_ratio_read"] = round(rused / rslots, 4)
     if fetches and wait_s:
         rec["device_step_ms"] = round(wait_s * 1e3 / fetches, 4)
+    # roofline plane (obs/roofline.py): CostCard-bound work charged over
+    # this window.  Absent when no card was available (degraded path) --
+    # the gate only compares fields both sides carry.
+    rl_flops = _counter_sum(delta, "ccs_roofline_flops_total")
+    if rl_flops > 0:
+        rec["roofline_flops"] = rl_flops
+        rec["roofline_bytes"] = _counter_sum(
+            delta, "ccs_roofline_bytes_total")
+        rl_wall = float(sum(
+            v for (n, _), v in delta.items()
+            if n == "ccs_roofline_refine_seconds_total"
+            and isinstance(v, (int, float))))
+        if rl_wall > 0:
+            from pbccs_tpu.obs import roofline as _roofline
+            achieved = rl_flops / 1e12 / rl_wall
+            peak = _roofline.tracker().peak_tflops()
+            rec["roofline_achieved_tflops"] = float(f"{achieved:.6g}")
+            if peak > 0:
+                rec["roofline_efficiency"] = float(
+                    f"{achieved / peak:.6g}")
     if workload is not None:
         rec["workload"] = workload
     if wall_s is not None:

@@ -3,14 +3,15 @@
 The round-3 device profile (docs/PROFILE_r03.md) showed the chunked
 mutation-scoring programs are HBM-bandwidth-bound: every elementwise step of
 the packed (Z, R, chunk, W) pipeline materializes a ~1.6 GB intermediate.
-This kernel replaced that path; by the round-5 profile (docs/PROFILE_r05.md)
-the dense sweep cost ~147 ms of device time at the headline config (93 ms
-refine-loop + 53 ms QV-sweep) against a ~3 ms VPU op-count bound, with
-another ~165 ms of surrounding layout/pad/fusion work -- the round-6 gap
-this file's multi-column blocking, 8-lane aux packing, and prepare-time
-layout pre-bake (DenseLayout) attack; docs/PROFILE_r06.md records the
-post-change attribution.  The kernel evaluates the Extend(2 cols)+Link
-algebra
+This kernel replaced that path.  Its achieved-vs-bound gap is no longer
+quoted here as hard-coded milliseconds (the round-5 snapshot figures
+rotted as the kernel evolved): the live bound is the per-bucket XLA
+CostCard and the measured side is the roofline plane's per-dispatch
+timing -- run `ccs roofline` (or read the ccs_roofline_* gauges /
+docs/PROFILE_r06.md for the attribution method).  The round-6 gap was
+attacked by this file's multi-column blocking, 8-lane aux packing, and
+prepare-time layout pre-bake (DenseLayout).  The kernel evaluates the
+Extend(2 cols)+Link algebra
 (reference ConsensusCore/src/C++/Arrow/SimpleRecursor.cpp:373-487, :306-357)
 for EVERY slot of the position-major mutation grid (9 slots per template
 position: 4 subs, 4 ins, 1 del -- models/arrow/mutations._SLOT_* order) with
@@ -105,10 +106,12 @@ def _interpret() -> bool:
 def dense_cols_per_step(nb: int | None = None) -> int:
     """Multi-column blocking: how many _PB-row position sub-blocks one
     kernel grid step processes (amortizing the per-step scan/setup and
-    pipeline-fetch overhead that dominated the round-5 kernel interior --
-    the dense kernel ran at ~50x its VPU op-count bound with one _PB
-    block per step).  Liveness granularity stays one _PB sub-block: dead
-    sub-blocks inside a live grid step still skip their compute.
+    pipeline-fetch overhead that dominated the round-5 kernel interior,
+    where the dense kernel ran far above its op-count bound with one _PB
+    block per step; today's measured multiple is the roofline plane's
+    achieved-vs-CostCard figure, `ccs roofline`).  Liveness granularity
+    stays one _PB sub-block: dead sub-blocks inside a live grid step
+    still skip their compute.
 
     Env override PBCCS_DENSE_CB (>= 1); clamped to the block count so
     short templates keep a non-degenerate grid."""

@@ -61,6 +61,7 @@ from pbccs_tpu.ops.mutation_score import (
     make_patches_fast,
 )
 from pbccs_tpu.obs import flight as obs_flight
+from pbccs_tpu.obs import roofline as obs_roofline
 from pbccs_tpu.obs import trace as obs_trace
 from pbccs_tpu.obs.metrics import default_registry, log_buckets
 from pbccs_tpu.parallel.mesh import READ_AXIS, ZMW_AXIS, pad_to
@@ -303,6 +304,14 @@ def _batch_setup(tpls, tlens, tables, reads, rlens, strands, tstarts, tends,
     return (win_tpl, win_trans, wlens, alpha, beta,
             ll_a, ll_b, apre, bsuf,
             trans_f, tpl_r, trans_r, table, mu, var)
+
+
+def lowering_target():
+    """The canonical per-bucket program the roofline plane lowers for
+    CostCard extraction (obs/roofline.py): the jitted _batch_setup.
+    Exposed as a function so roofline never imports batch at module
+    scope (batch imports roofline; this breaks the cycle)."""
+    return _batch_setup
 
 
 @jax.jit
@@ -642,6 +651,18 @@ class BatchPolisher:
         # flight-recorder batch tag: first ZMW id + batch size names the
         # batch compactly in postmortem dumps
         self._flight_tag = f"{self.ids[0]}+{self.n_zmws}"
+        # roofline CostCard: one AOT extraction per shape bucket per
+        # process (memoized + disk-cached), BEFORE the first _setup so
+        # its execution charge finds the card -- a process whose only
+        # polisher is the bucket's first would otherwise never charge.
+        # The AOT compile warms the persistent cache for the jit call
+        # below (same program, same statics).  Mesh runs skip it -- the
+        # canonical card program is the mesh=None lowering.
+        if self.mesh is None:
+            obs_roofline.note_bucket(
+                imax=self._Imax, jmax=self._Jmax, r=self._R, z=self._Z,
+                width=self._W, use_pallas=fills_use_pallas(),
+                guided_passes=guided_fill_passes(self._Jmax))
         self._setup(first=True)
 
     # --------------------------------------------------- AddRead statistics
@@ -770,6 +791,10 @@ class BatchPolisher:
             mesh=self.mesh,
             guided_passes=guided_fill_passes(self._Jmax))
         self.alpha, self.beta = alpha, beta
+        # charge this execution of the canonical program against the
+        # bucket's CostCard bound (no-op until a card exists)
+        obs_roofline.charge_execution(imax=self._Imax, jmax=self._Jmax,
+                                      r=self._R, z=self._Z)
         self._tpl_dev = self._shard(tl)
         self._tpl32_dev = self._tpl_dev.astype(jnp.int32)
         self._tpl32_r_dev = self.tpl_r.astype(jnp.int32)
@@ -1434,6 +1459,11 @@ class BatchPolisher:
         (defaults to opts.max_iterations); a straggler continuation passes
         its remaining rounds so parent + continuation together never exceed
         the reference's single max_iterations bound."""
+        with obs_roofline.refine_scope(imax=self._Imax, jmax=self._Jmax,
+                                       r=self._R):
+            return self._refine_impl(opts, skip, budget)
+
+    def _refine_impl(self, opts, skip, budget) -> list[RefineResult]:
         opts = opts or RefineOptions()
         if budget is None:
             budget = opts.max_iterations

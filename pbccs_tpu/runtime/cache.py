@@ -8,9 +8,27 @@ directory."""
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 _monitoring_installed = False
+_suppress_events = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_cache_metrics():
+    """Hide compile/cache-event counts from the ledger counters for the
+    duration.  Used by the roofline CostCard extraction: its AOT compile
+    of the canonical bucket program races the workload's own jit on the
+    shared persistent cache, so counting its hit/miss would make the
+    deterministic compile-class ledger counters timing-dependent."""
+    prev = getattr(_suppress_events, "v", False)
+    _suppress_events.v = True
+    try:
+        yield
+    finally:
+        _suppress_events.v = prev
 
 
 def _install_cache_metrics() -> None:
@@ -35,6 +53,8 @@ def _install_cache_metrics() -> None:
                            "jax.monitoring")
 
     def on_event(event: str, **kw) -> None:
+        if getattr(_suppress_events, "v", False):
+            return
         if "compilation_cache" in event:
             if "hit" in event:
                 hits.inc()

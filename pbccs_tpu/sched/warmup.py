@@ -130,6 +130,7 @@ def run_warmup(argv: list[str] | None = None) -> int:
     targets = devices if args.allDevices else devices[:1]
     entries = [parse_bucket(b) for b in args.bucket]
 
+    from pbccs_tpu.obs import roofline
     from pbccs_tpu.parallel.batch import effective_shapes
     from pbccs_tpu.resilience import resources
 
@@ -177,10 +178,25 @@ def run_warmup(argv: list[str] | None = None) -> int:
                      "seconds": round(dt, 2), "shapes": shapes}
             if len(sub) < len(tasks):
                 entry["governor_clamped_z"] = len(sub)
+            # the polish above minted (and persisted) this bucket's
+            # roofline CostCard; surface it so warmup output doubles as
+            # the bound report for the menu
+            card = roofline.tracker().card(
+                roofline.bucket_label(imax, jmax, r))
+            if card is not None:
+                entry["cost_card"] = {
+                    "label": card.label, "flops": card.flops,
+                    "bytes_accessed": card.bytes_accessed,
+                    "peak_hbm_bytes": card.peak_hbm_bytes,
+                    "intensity": card.intensity, "card_z": card.z}
             report.append(entry)
             log.info(f"warmup: {entry['bucket']} on {name}: "
                      f"{dt:.1f}s, shapes {shapes}")
-    print(json.dumps({"warmed": report}))
+    out: dict = {"warmed": report}
+    cards_file = roofline.cards_path()
+    if cards_file:
+        out["roofline_cards"] = cards_file
+    print(json.dumps(out))
     log.flush()
     return 0
 

@@ -25,6 +25,15 @@ the gate is strict exactly where determinism makes strictness honest:
   resource  peak RSS: median vs a wide relative band (default 50%),
             same platform rule as wall.
 
+An optional baseline ``floors`` section maps ledger fields to hard
+MINIMUMS (violation class "floor"): the roofline efficiency floor for
+the headline config lives here, so a kernel-share slide is caught by
+the sentinel even when the relative wall band would tolerate it.
+Floors follow their field's class gating (wall-class floors only on a
+matching accelerator platform; none in --counters-only mode) and are
+carried through --update-baseline verbatim -- they are policy, not
+measurement.
+
 Exit 0 clean; exit 1 with ONE structured JSON diff line per violation
 (metric, class, baseline, observed, tolerance); exit 2 on usage errors
 (no ledger, no matching records, bad baseline).
@@ -133,6 +142,16 @@ def bad_baseline_reason(baseline: dict) -> str | None:
     select = baseline.get("select")
     if select is not None and not isinstance(select, dict):
         return "select must be an object"
+    floors = baseline.get("floors")
+    if floors is not None:
+        if not isinstance(floors, dict):
+            return "floors must be an object"
+        for name, val in floors.items():
+            if not _numeric(val):
+                return (f"floors.{name} must be a number, got "
+                        f"{type(val).__name__}")
+            if LEDGER_FIELDS.get(name) not in _GATED:
+                return (f"floors.{name}: not a gated ledger field")
     return None
 
 
@@ -214,6 +233,32 @@ def compare(baseline: dict, records: list[dict], *,
                 violations.append(_violation(metric, cls, base_val,
                                              round(obs_val, 4),
                                              tol[cls]))
+
+    # floors: hard minimums (e.g. roofline_efficiency for the headline
+    # config) -- a kernel-share slide fails here even when the relative
+    # band above would tolerate it.  Enforcement gating mirrors the
+    # floor field's class: wall/resource floors only on a matching
+    # accelerator platform, compile floors only on a matching jax, and
+    # none of them in --counters-only mode.
+    floors = baseline.get("floors") or {}
+    for metric, floor in sorted(floors.items()):
+        cls = LEDGER_FIELDS.get(metric)
+        if cls not in _GATED or not _numeric(floor):
+            continue
+        if counters_only:
+            notes.append(f"floor {metric!r} skipped in counters-only "
+                         "mode")
+            continue
+        if cls == "compile" and not jax_match:
+            continue
+        if cls in ("wall", "resource") and not wall_enforced:
+            notes.append(f"floor {metric!r} skipped on platform "
+                         f"{platform!r}")
+            continue
+        obs_val = obs.get(metric)
+        if not _numeric(obs_val) or obs_val < floor:
+            violations.append(_violation(metric, "floor", floor,
+                                         obs_val, 0.0))
     return violations, notes
 
 
@@ -245,6 +290,12 @@ def update_baseline(path: str, baseline: dict | None,
                            old_tol if isinstance(old_tol, dict)
                            and all(_numeric(v) for v in old_tol.values())
                            else None)
+    # floors are policy, not measurement: carry them through verbatim
+    # (a refresh must not silently drop the efficiency floor)
+    old_floors = (baseline or {}).get("floors")
+    if isinstance(old_floors, dict) and old_floors \
+            and all(_numeric(v) for v in old_floors.values()):
+        fresh["floors"] = old_floors
     for metric in sorted(set(old_metrics) | set(fresh["metrics"])):
         old, new = old_metrics.get(metric), fresh["metrics"].get(metric)
         if old != new:

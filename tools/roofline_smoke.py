@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Roofline-plane smoke for the tier-1 gate: CostCard determinism +
+report + sentinel wiring.
+
+Warms a 2-bucket menu TWICE through `ccs warmup` (fresh subprocess each
+time; the persistent compile cache is SHARED so run 2 is cheap, but the
+card stores are SEPARATE files so both runs extract fresh cards), then
+asserts the properties the roofline attribution plane is trusted for:
+
+  1. CARDS: every warmed bucket reports a CostCard (flops > 0) and the
+     card store is written beside the compile cache;
+  2. DETERMINISM: the two independently-extracted card stores are
+     byte-identical -- XLA's cost model is a deterministic function of
+     the bucket program, which is what makes flops/bytes honest
+     "counter"-class ledger fields;
+  3. REPORT: `ccs roofline --cards ... --format json` parses with one
+     row per bucket (and the text renderer runs);
+  4. SENTINEL: tools/perf_gate.py accepts a ledger carrying the new
+     roofline_* fields, enforces the efficiency floor, and fails a
+     perturbed-flops ledger with a structured diff naming the metric;
+     obs.ledger rejects an undeclared roofline field (REG011-style).
+
+The card store is copied to $ARTIFACTS_DIR (default
+/tmp/ccs-perf-artifacts) for CI upload.
+
+Usage:  JAX_PLATFORMS=cpu python tools/roofline_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# two buckets with distinct compiled shapes (Jmax 64 vs 128), small
+# enough that the cold compile stays in tier-1 budget
+BUCKETS = ("4x3x48", "4x3x100")
+
+
+def run_warmup(tmp: str, cache: str, tag: str) -> tuple[dict, str]:
+    """One fresh `ccs warmup` subprocess with its own card store;
+    returns (report_doc, cards_path)."""
+    cards = os.path.join(tmp, f"cards_{tag}.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PBCCS_ROOFLINE_CARDS=cards)
+    env.pop("PBCCS_ROOFLINE", None)
+    cmd = [sys.executable, "-m", "pbccs_tpu.cli", "warmup",
+           "--compileCache", cache, "--logLevel", "WARN"]
+    for b in BUCKETS:
+        cmd += ["--bucket", b]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=480, env=env, cwd=REPO)
+    if proc.returncode != 0:
+        raise AssertionError(f"warmup {tag} failed rc={proc.returncode}:"
+                             f"\n{proc.stderr[-2000:]}")
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(f"roofline_smoke: warmup {tag} OK in "
+          f"{time.monotonic() - t0:.1f}s")
+    return doc, cards
+
+
+def assert_cards(doc: dict, cards: str, tag: str) -> None:
+    warmed = doc.get("warmed") or []
+    assert len(warmed) == len(BUCKETS), doc
+    for entry in warmed:
+        card = entry.get("cost_card")
+        assert card, f"warmup {tag}: bucket {entry.get('bucket')} has " \
+                     f"no cost_card: {entry}"
+        assert card["flops"] > 0, entry
+        assert card["bytes_accessed"] > 0, entry
+    assert doc.get("roofline_cards") == cards, doc
+    with open(cards) as f:
+        store = json.load(f)
+    labels = sorted((store.get("cards") or {}))
+    assert len(labels) == len(BUCKETS), \
+        f"warmup {tag}: want {len(BUCKETS)} cards, got {labels}"
+    print(f"roofline_smoke: cards {tag} OK ({', '.join(labels)})")
+
+
+def run_gate(argv: list[str]) -> tuple[int, str]:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py")]
+        + argv, capture_output=True, text=True, timeout=120)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def check_sentinel(tmp: str) -> None:
+    """Ledger schema + perf_gate wiring for the roofline fields, on a
+    synthetic accelerator-platform ledger (floors are enforced only on
+    matching accelerator platforms, so a CPU CI host still exercises
+    the whole path)."""
+    from pbccs_tpu.obs.ledger import LedgerSchemaError, PerfLedger
+
+    led = PerfLedger(os.path.join(tmp, "schema_probe.ndjson"))
+    try:
+        led.append({"kind": "batch_run", "roofline_bogus": 1})
+        raise AssertionError("ledger accepted an undeclared roofline "
+                             "field")
+    except LedgerSchemaError:
+        pass
+    led.append({"kind": "batch_run", "roofline_flops": 1,
+                "roofline_bytes": 2, "roofline_achieved_tflops": 0.5,
+                "roofline_efficiency": 0.01})
+    print("roofline_smoke: ledger schema OK (declared fields accepted, "
+          "undeclared rejected)")
+
+    rec = {"schema_version": 1, "kind": "batch_run", "source": "smoke",
+           "platform": "tpu", "jax_version": "smoke-jax", "zmws": 8,
+           "roofline_flops": 1_000_000, "roofline_bytes": 2_000_000,
+           "roofline_achieved_tflops": 2.0, "roofline_efficiency": 0.5}
+    ledger = os.path.join(tmp, "roofline_ledger.ndjson")
+    with open(ledger, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    baseline = os.path.join(tmp, "baseline.json")
+    rc, out = run_gate([ledger, "--update-baseline",
+                        "--baseline", baseline])
+    assert rc == 0, f"baseline update failed:\n{out}"
+    with open(baseline) as f:
+        base = json.load(f)
+    for field in ("roofline_flops", "roofline_bytes",
+                  "roofline_achieved_tflops", "roofline_efficiency"):
+        assert field in base["metrics"], base["metrics"]
+    base["floors"] = {"roofline_efficiency": 0.1}
+    with open(baseline, "w") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+
+    rc, out = run_gate([ledger, "--baseline", baseline])
+    assert rc == 0, f"gate failed a clean roofline ledger:\n{out}"
+    print("roofline_smoke: perf_gate OK on a clean roofline ledger "
+          "(floor enforced, passing)")
+
+    perturbed = dict(rec, roofline_flops=rec["roofline_flops"] + 12345)
+    bad = os.path.join(tmp, "perturbed.ndjson")
+    with open(bad, "w") as f:
+        f.write(json.dumps(perturbed) + "\n")
+    rc, out = run_gate([bad, "--counters-only", "--baseline", baseline])
+    assert rc == 1, f"gate must fail perturbed roofline_flops:\n{out}"
+    assert "roofline_flops" in out and "perf_gate_violation" in out, out
+
+    slid = dict(rec, roofline_efficiency=0.05,
+                roofline_achieved_tflops=0.2)
+    bad2 = os.path.join(tmp, "slid.ndjson")
+    with open(bad2, "w") as f:
+        f.write(json.dumps(slid) + "\n")
+    rc, out = run_gate([bad2, "--baseline", baseline])
+    assert rc == 1, f"gate must fail an efficiency-floor slide:\n{out}"
+    assert "roofline_efficiency" in out and '"floor"' in out, out
+    print("roofline_smoke: perturbed ledgers correctly rejected "
+          "(counter diff + efficiency floor)")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    tmp = tempfile.mkdtemp(prefix="pbccs_roofline_smoke_")
+    try:
+        cache = os.path.join(tmp, "compile_cache")
+        doc_a, cards_a = run_warmup(tmp, cache, "a")
+        assert_cards(doc_a, cards_a, "a")
+        doc_b, cards_b = run_warmup(tmp, cache, "b")
+        assert_cards(doc_b, cards_b, "b")
+
+        blob_a = open(cards_a, "rb").read()
+        blob_b = open(cards_b, "rb").read()
+        assert blob_a == blob_b, (
+            "CostCard stores from two fresh-process extractions differ "
+            "-- the XLA cost model stopped being deterministic for the "
+            "bucket program (diff the two JSON files)")
+        print(f"roofline_smoke: determinism OK ({len(blob_a)} bytes "
+              "byte-identical across fresh processes)")
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "pbccs_tpu.cli", "roofline",
+             "--cards", cards_a, "--format", "json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout)
+        assert report["source"] == "cards", report
+        assert len(report["rows"]) == len(BUCKETS), report
+        for row in report["rows"]:
+            assert row["flops"] > 0, row
+        proc = subprocess.run(
+            [sys.executable, "-m", "pbccs_tpu.cli", "roofline",
+             "--cards", cards_a],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0 and "BUCKET" in proc.stdout, \
+            proc.stdout + proc.stderr
+        print("roofline_smoke: ccs roofline report OK (json + text)")
+
+        check_sentinel(tmp)
+
+        art_dir = os.environ.get("ARTIFACTS_DIR",
+                                 "/tmp/ccs-perf-artifacts")
+        os.makedirs(art_dir, exist_ok=True)
+        shutil.copy(cards_a,
+                    os.path.join(art_dir, "roofline_cards.json"))
+        print(f"roofline_smoke: card artifact -> "
+              f"{os.path.join(art_dir, 'roofline_cards.json')}")
+        print(f"roofline_smoke: PASS in {time.monotonic() - t0:.1f}s")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

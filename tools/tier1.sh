@@ -55,6 +55,12 @@ echo "== perf smoke (ledger schema + counter determinism + perf_gate vs PERF_BAS
 # counters-only mode and reject a perturbed one with a structured diff
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/perf_smoke.py || exit 1
 
+echo "== roofline smoke (CostCard determinism + ccs roofline + efficiency floor gate) =="
+# two fresh-process warmups of a 2-bucket menu (shared compile cache,
+# separate card stores): cards must be byte-identical, the report must
+# parse, and perf_gate must enforce the new roofline fields + floor
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/roofline_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
