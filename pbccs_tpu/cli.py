@@ -359,6 +359,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.serve.router import run_router
 
         return run_router(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `ccs fleet`: self-healing supervised fleet (serve/supervisor)
+        from pbccs_tpu.serve.supervisor import run_fleet
+
+        return run_fleet(argv[1:])
     if argv and argv[0] == "warmup":
         # `ccs warmup`: precompile a declared bucket menu (pbccs_tpu/sched)
         from pbccs_tpu.sched.warmup import run_warmup

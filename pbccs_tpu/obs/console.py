@@ -189,6 +189,9 @@ def fleet_view(cur: dict, prev: dict | None, target: str
         fleet = {k: status.get(k) for k in
                  ("accepting", "pending", "routed", "completed",
                   "failovers", "deduped", "uptime_s")}
+        supervisor = status.get("supervisor")
+        if supervisor:
+            _merge_supervisor(replicas, supervisor, fleet)
     else:
         replicas.append(_replica_row(None, metrics, prev, dt))
         fleet = {k: status.get(k) for k in
@@ -202,6 +205,34 @@ def fleet_view(cur: dict, prev: dict | None, target: str
         "replicas": replicas,
         "fleet": fleet,
     }
+
+
+def _merge_supervisor(replicas: list[dict], supervisor: dict,
+                      fleet: dict) -> None:
+    """Fold the `ccs fleet` supervisor status block into the frame:
+    roster rows gain their slot identity/state, and slots with NO roster
+    presence (quarantined dead, restarting pre-join, retiring) become
+    synthetic absent rows -- so a missing replica reads as *restarting in
+    2s* or *dead: crash-loop*, never as a silently shorter table."""
+    named = {}
+    for row in replicas:
+        named[row.get("replica")] = row
+    for slot in supervisor.get("slots", ()):
+        row = named.get(slot.get("replica"))
+        if row is None:
+            row = {"replica": slot.get("replica")
+                   or f"slot/{slot.get('slot')}",
+                   "absent": True}
+            replicas.append(row)
+        row["slot"] = slot.get("slot")
+        row["slot_state"] = slot.get("state")
+        if slot.get("reason"):
+            row["slot_reason"] = slot["reason"]
+        if slot.get("backoff_s"):
+            row["backoff_s"] = slot["backoff_s"]
+    fleet["supervisor_events"] = list(supervisor.get("events", ()))[-5:]
+    if supervisor.get("rolling_restart"):
+        fleet["rolling_restart"] = supervisor["rolling_restart"]
 
 
 # ------------------------------------------------------------ rendering
@@ -228,7 +259,17 @@ def render_text(view: dict[str, Any]) -> str:
     ]
     for r in view["replicas"]:
         if r.get("absent"):
-            lines.append(f"{r['replica']:<22} {'n':>3}  (absent)")
+            # with a supervisor in the loop an absent row has a CAUSE:
+            # restarting (with its backoff), draining out, or dead
+            # (crash-loop quarantined) -- plain (absent) otherwise
+            state = r.get("slot_state")
+            label = f"({state})" if state and state not in ("up",) \
+                else "(absent)"
+            if state == "restarting" and r.get("backoff_s"):
+                label += f" backoff {r['backoff_s']:g}s"
+            if r.get("slot_reason"):
+                label += f"  {r['slot_reason']}"
+            lines.append(f"{r['replica']:<22} {'n':>3}  {label}")
             continue
         slo = r.get("slo", {})
         burn = slo.get("window_burn_rate",
@@ -245,6 +286,19 @@ def render_text(view: dict[str, Any]) -> str:
             f"{_fmt(ref.get('slot_occupancy'), 6, 3)} "
             f"{_fmt(ref.get('padding_waste'), 6, 3)} "
             f"{_fmt(rl.get('efficiency'), 9, 6)}")
+    rolling = view["fleet"].get("rolling_restart")
+    if rolling:
+        lines.append(
+            f"rolling restart: {rolling.get('state')} "
+            f"current={rolling.get('current')} "
+            f"done={rolling.get('done')}/{rolling.get('plan')}")
+    events = view["fleet"].get("supervisor_events") or ()
+    for ev in list(events)[-3:]:
+        slot = ev.get("slot")
+        lines.append(
+            f"fleet: {ev.get('event')}"
+            + (f" slot={slot}" if slot is not None else "")
+            + (f"  {ev.get('reason')}" if ev.get("reason") else ""))
     return "\n".join(lines)
 
 

@@ -64,7 +64,8 @@ LEDGER_FIELDS = {
     # ---- identity / environment (meta) ----
     "schema_version": "meta",
     "kind": "meta",            # batch_run | bench_row | serve_snapshot |
-    #                            router_snapshot | replica_snapshot
+    #                            router_snapshot | replica_snapshot |
+    #                            fleet_event
     "t_unix": "meta",
     "source": "meta",          # emitting process/row identity
     "workload": "meta",        # free-form workload descriptor (dict)
@@ -120,6 +121,15 @@ LEDGER_FIELDS = {
     "compiles": "compile",
     "compile_cache_hits": "compile",
     "compile_cache_misses": "compile",
+    # ---- fleet-autopilot events (meta: audit trail, never gated) ----
+    # one record per supervisor decision (kind == "fleet_event"):
+    # respawn | quarantine | readmit | scale_up | scale_down | add |
+    # remove | drain_kill | rolling_restart_begin / _step / _done
+    "fleet_event": "meta",
+    "slot": "meta",            # supervisor slot index the event is about
+    "reason": "meta",          # structured cause (quarantine/bench text)
+    "attempt": "meta",         # respawn attempt number within the window
+    "backoff_s": "meta",       # backoff applied before the next respawn
     # ---- live serving state (recorded, never gated) ----
     "uptime_s": "live",
     "pending": "live",

@@ -29,8 +29,16 @@ Spec grammar (comma-separated entries):
                      production error handling, not a chaos special case
            delay     sleep arg seconds (a hang, for the watchdog)
            corrupt   mutate the payload passed to corrupt() at the site
+           crashloop os._exit(86) -- the process dies instantly, like a
+                     segfaulting binary, so the fleet supervisor's
+                     crash-loop quarantine is chaos-testable without a
+                     real broken build.  arg = how many supervisor
+                     incarnations die (the supervisor exports the
+                     0-based respawn counter as
+                     PBCCS_FLEET_INCARNATION); no arg = every one
     ~key   fire only when one of the caller's keys equals `key`
-           (poison-ZMW selection: keys are ZMW ids at polish sites)
+           (poison-ZMW selection: keys are ZMW ids at polish sites;
+           the supervisor's serve.start site keys on the fleet slot)
     @at    fire only on the at-th eligible call (1-based)
     %prob  fire with probability prob (seeded; default 1.0)
     *times fire at most `times` times total (default unlimited)
@@ -44,6 +52,7 @@ Examples:
     sched.dispatch:oom@1*1               # one device OOM -> split
     checkpoint.record:enospc@3*1         # disk fills at record 3
     output.write:enospc~bam@1*1          # BAM writer hits a full disk
+    serve.start:crashloop=3~1            # fleet slot 1 dies 3 spawns
 
 Enable via environment (read once, on first site hit):
 
@@ -87,10 +96,32 @@ class FaultSpecError(ValueError):
 # the injectable failure vocabulary, one name per shaped recovery path:
 # error (transient raise), delay (latency), corrupt (payload bytes),
 # oom (capacity-shaped RESOURCE_EXHAUSTED -> governor split), enospc
-# (disk-full OSError -> atomic-writer recovery).  This tuple is the
+# (disk-full OSError -> atomic-writer recovery), crashloop (instant
+# process death -> supervisor respawn/quarantine).  This tuple is the
 # single source of truth -- the spec parser validates against it and
 # `ccs analyze` (REG008) keeps the DESIGN.md fault-kinds table in sync.
-FAULT_KINDS = ("error", "delay", "corrupt", "oom", "enospc")
+FAULT_KINDS = ("error", "delay", "corrupt", "oom", "enospc", "crashloop")
+
+# exit status of a crashloop-killed process (distinctive on purpose, so
+# a supervisor log line attributes the death to injection at a glance)
+CRASHLOOP_EXIT = 86
+
+
+def _crashloop_armed(spec: FaultSpec) -> bool:
+    """crashloop=N dies only while this process's fleet incarnation
+    (the supervisor's 0-based respawn counter, exported as
+    PBCCS_FLEET_INCARNATION) is < N; no/zero arg = every incarnation."""
+    if not spec.arg:
+        return True
+    try:
+        n = int(spec.arg)
+    except ValueError:
+        return True
+    try:
+        inc = int(os.environ.get("PBCCS_FLEET_INCARNATION", "0") or 0)
+    except ValueError:
+        inc = 0
+    return n <= 0 or inc < n
 
 
 @dataclasses.dataclass
@@ -199,6 +230,8 @@ class FaultInjector:
             for i, spec in enumerate(self.specs):
                 if spec.site != site or spec.kind == "corrupt":
                     continue
+                if spec.kind == "crashloop" and not _crashloop_armed(spec):
+                    continue   # this incarnation survives (arg exhausted)
                 if not self._due(i, spec, keys):
                     continue
                 self._record(spec)
@@ -209,6 +242,10 @@ class FaultInjector:
         if delay > 0.0:
             time.sleep(delay)
         if boom is not None:
+            if boom.kind == "crashloop":
+                # die like a segfault: no drain, no traceback, no exit
+                # handlers -- the supervisor must see a hard child death
+                os._exit(CRASHLOOP_EXIT)
             if boom.kind == "enospc":
                 # the REAL exception class a full disk produces, so the
                 # armed writer site exercises its production OSError

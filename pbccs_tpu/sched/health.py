@@ -151,6 +151,11 @@ class HealthTracker:
     def healthy(self, member_id: Hashable) -> bool:
         return self._member(member_id).healthy
 
+    def forget(self, member_id: Hashable) -> None:
+        """Drop a member's health state entirely (it left the fleet);
+        a future member reusing the name starts healthy, no strikes."""
+        self._members.pop(member_id, None)
+
     def record_failure(self, member_id: Hashable) -> bool:
         """One failed probe/dispatch; True when this strike benched the
         member (the caller fails over its in-flight work ONCE)."""

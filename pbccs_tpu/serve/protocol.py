@@ -39,6 +39,9 @@ Client verbs:
   status  {"verb": "status", "id": ...}
   metrics {"verb": "metrics", "id": ...}
   trace   {"verb": "trace", "id": ..., "action": "start" | "stop"}
+  fleet   {"verb": "fleet", "id": ..., "action": "list" | "add" |
+           "remove" | "restart" | "readmit", "replica": "host:port",
+           "timeout_s": ...}   # replica/timeout_s action-dependent
   ping    {"verb": "ping", "id": ...}
 
 Server replies:
@@ -58,6 +61,9 @@ Server replies:
   trace   {"type": "trace", "id": ..., "state": "started" |
            "already_running" | "stopped" | "not_running",
            "trace": {..Chrome-trace JSON..}}  # on state "stopped" only
+  fleet   {"type": "fleet", "id": ..., "action": <echoed>, "ok": true,
+           ...action-specific fields (replicas roster for list, the
+           member name for add/remove, drain outcome for remove)...}
   pong    {"type": "pong", "id": ...}
   closed  {"type": "closed", "reason": "draining" | "idle_timeout"}
           -- unsolicited: the server is about to close this session
@@ -101,6 +107,7 @@ VERB_SUBMIT = "submit"
 VERB_STATUS = "status"
 VERB_METRICS = "metrics"
 VERB_TRACE = "trace"
+VERB_FLEET = "fleet"
 VERB_PING = "ping"
 
 # server reply types
@@ -109,6 +116,7 @@ TYPE_ERROR = "error"
 TYPE_STATUS = "status"
 TYPE_METRICS = "metrics"
 TYPE_TRACE = "trace"
+TYPE_FLEET = "fleet"
 TYPE_PONG = "pong"
 TYPE_CLOSED = "closed"
 
@@ -142,6 +150,15 @@ KEY_ROOFLINE_SCHEMA = "schema_version"
 KEY_ROOFLINE_PEAK = "peak_tflops"
 KEY_ROOFLINE_BUCKETS = "buckets"
 
+# status-verb supervisor block (serve/supervisor.py status_block): the
+# fleet autopilot's slot table (state machine per managed replica
+# process), its recent fleet events, and rolling-restart progress.
+# Present only when a supervisor controls the answering router.
+FIELD_SUPERVISOR = "supervisor"
+KEY_SUP_SLOTS = "slots"
+KEY_SUP_EVENTS = "events"
+KEY_SUP_ROLLING = "rolling_restart"
+
 
 # ------------------------------------------------------------------ wire spec
 #
@@ -174,11 +191,13 @@ WIRE_VERBS = {
     VERB_METRICS: {"handler": "_on_metrics", "replies": (TYPE_METRICS,)},
     VERB_TRACE: {"handler": "_on_trace",
                  "replies": (TYPE_TRACE, TYPE_ERROR)},
+    VERB_FLEET: {"handler": "_on_fleet",
+                 "replies": (TYPE_FLEET, TYPE_ERROR)},
     VERB_PING: {"handler": None, "replies": (TYPE_PONG,)},
 }
 
 WIRE_REPLIES = (TYPE_RESULT, TYPE_ERROR, TYPE_STATUS, TYPE_METRICS,
-                TYPE_TRACE, TYPE_PONG, TYPE_CLOSED)
+                TYPE_TRACE, TYPE_FLEET, TYPE_PONG, TYPE_CLOSED)
 
 # server->client types no verb elicits (drain / idle-reap notices)
 WIRE_UNSOLICITED = (TYPE_CLOSED,)
@@ -206,6 +225,13 @@ WIRE_FIELDS = {
     FIELD_ROOFLINE: {"keys": (KEY_ROOFLINE_SCHEMA, KEY_ROOFLINE_PEAK,
                               KEY_ROOFLINE_BUCKETS),
                      "verbs": (VERB_STATUS,)},
+    # rides the STATUS exchange: present when a fleet supervisor
+    # (serve/supervisor.py) controls the answering router -- the slot
+    # table `ccs top` renders restarting/dead/draining states from,
+    # plus the recent fleet events and rolling-restart progress.
+    FIELD_SUPERVISOR: {"keys": (KEY_SUP_SLOTS, KEY_SUP_EVENTS,
+                                KEY_SUP_ROLLING),
+                       "verbs": (VERB_STATUS,)},
 }
 
 

@@ -96,6 +96,18 @@ class RouterConfig:
     bench_after: int = 2             # strikes before a replica is unhealthy
     readmit_after: int = 1           # good probes before re-admission
     connect_timeout_s: float = 5.0   # replica (re)connect bound
+    # reconnect attempts to a DOWN replica back off exponentially
+    # (base, doubling, capped) instead of re-firing a blocking connect
+    # on every health tick against a replica the supervisor knows is
+    # mid-restart; a successful connect resets the schedule.  Skipped
+    # ticks count ccs_router_reconnect_backoffs_total{replica}.
+    reconnect_backoff_base_s: float = 0.5
+    reconnect_backoff_cap_s: float = 15.0
+    # dynamic membership (fleet verb / supervisor): allow a router that
+    # starts with ZERO replicas -- members arrive via add_replica() as
+    # the supervisor's children come up -- and allow removing the last
+    # member (the supervisor cycles 1-replica fleets through restarts)
+    allow_empty: bool = False
     # ---- routing ----
     # a home replica keeps its bucket while its in-flight depth is <=
     # spill_depth; past it the least-loaded healthy replica takes the
@@ -131,6 +143,27 @@ class RouterConfig:
             raise ValueError("health_timeout_s must be > 0")
         if self.connect_timeout_s <= 0:
             raise ValueError("connect_timeout_s must be > 0")
+        if self.reconnect_backoff_base_s <= 0:
+            raise ValueError("reconnect_backoff_base_s must be > 0")
+        if self.reconnect_backoff_cap_s < self.reconnect_backoff_base_s:
+            raise ValueError("reconnect_backoff_cap_s must be >= "
+                             "reconnect_backoff_base_s")
+
+
+def parse_replica_spec(spec) -> tuple[str, int]:
+    """Normalize one replica spec -- "host:port" (host defaulting to
+    loopback) or a (host, port) pair -- raising ValueError with a
+    usage-shaped message on garbage (the fleet verb surfaces it as
+    bad_request)."""
+    if isinstance(spec, str):
+        host, _, port_s = spec.rpartition(":")
+        try:
+            return host or "127.0.0.1", int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"replica spec {spec!r}: want HOST:PORT") from None
+    host, port = spec
+    return host, int(port)
 
 
 def route_key(chunk) -> tuple[int, int]:
@@ -254,6 +287,11 @@ class _Replica:
         self.link: ReplicaLink | None = None
         self.connecting = False     # a reconnect attempt is in flight
         self.draining = False       # replica said it stopped accepting
+        # reconnect backoff (exponential, capped): no attempt before
+        # reconnect_at; a failed attempt doubles reconnect_backoff_s, a
+        # successful connect resets both
+        self.reconnect_backoff_s = 0.0
+        self.reconnect_at = 0.0
         # engine-reported pending work BEYOND this router's own
         # in-flight (other clients / engine backlog), refreshed by each
         # status probe: routing weighs it so an unevenly-loaded fleet
@@ -285,6 +323,11 @@ class _Replica:
         self.m_inflight = _reg.gauge(
             "ccs_router_inflight",
             "Requests in flight per replica", replica=self.name)
+        self.m_reconnect_backoff = _reg.counter(
+            "ccs_router_reconnect_backoffs_total",
+            "Health ticks that skipped a reconnect attempt while a down "
+            "replica's exponential backoff window was open",
+            replica=self.name)
 
     def depth(self) -> int:
         return len(self.inflight)
@@ -307,23 +350,15 @@ class CcsRouter:
         """`replicas`: "host:port" strings or (host, port) pairs."""
         self.config = config or RouterConfig()
         self._log = logger or Logger.default()
-        parsed = []
-        for spec in replicas:
-            if isinstance(spec, str):
-                host, _, port_s = spec.rpartition(":")
-                try:
-                    parsed.append((host or "127.0.0.1", int(port_s)))
-                except ValueError:
-                    raise ValueError(
-                        f"replica spec {spec!r}: want HOST:PORT") from None
-            else:
-                host, port = spec
-                parsed.append((host, int(port)))
-        if not parsed:
+        parsed = [parse_replica_spec(spec) for spec in replicas]
+        if not parsed and not self.config.allow_empty:
             raise ValueError("CcsRouter needs at least one replica")
         self._replicas = [_Replica(i, h, p)
                           for i, (h, p) in enumerate(parsed)]
         self._by_name = {r.name: r for r in self._replicas}
+        # monotone member index: removed slots never recycle an index,
+        # so a re-added name gets fresh bookkeeping order
+        self._replica_seq = len(self._replicas)
         self._lock = threading.Lock()
         self._sticky = StickyMap()
         self._health = HealthTracker(HealthPolicy(
@@ -343,6 +378,9 @@ class CcsRouter:
         self._capture: obs_trace.Tracer | None = None
         self._accepting = False    # submit gate (drain flips this first)
         self._down = True          # hard stop (failover stops too)
+        # fleet supervisor hook (serve/supervisor.py): its status block
+        # rides the status verb and fleet restart/readmit delegate to it
+        self._supervisor = None
         self._routed_total = 0
         self._completed_total = 0
         self._failover_total = 0
@@ -366,7 +404,9 @@ class CcsRouter:
             self._accepting = True
             self._down = False
         self._start_t = time.monotonic()
-        for replica in self._replicas:
+        with self._lock:
+            initial = list(self._replicas)
+        for replica in initial:
             self._try_connect(replica)
         self._stop.clear()
         emit_queue: queue.Queue = queue.Queue()
@@ -395,11 +435,12 @@ class CcsRouter:
                 self._ledger_window = timing.window()
                 self._ledger_thread = ledger_thread
             ledger_thread.start()
-        up = sum(1 for r in self._replicas if r.link is not None)
+        with self._lock:
+            names = [r.name for r in self._replicas]
+            up = sum(1 for r in self._replicas if r.link is not None)
         self._log.info(
-            f"ccs router up: {len(self._replicas)} replica(s) "
-            f"[{', '.join(r.name for r in self._replicas)}], "
-            f"{up} connected")
+            f"ccs router up: {len(names)} replica(s) "
+            f"[{', '.join(names)}], {up} connected")
         return self
 
     def close(self, drain: bool = True,
@@ -498,6 +539,112 @@ class CcsRouter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---------------------------------------------------- dynamic membership
+
+    def set_supervisor(self, supervisor) -> None:
+        """Install the fleet supervisor (serve/supervisor.py, or None to
+        clear): its status_block() rides every status reply under
+        FIELD_SUPERVISOR, and the fleet verb's restart/readmit actions
+        delegate to it."""
+        with self._lock:
+            self._supervisor = supervisor
+
+    def get_supervisor(self):
+        with self._lock:
+            return self._supervisor
+
+    def pending_count(self) -> int:
+        """Requests admitted but not yet answered (the autoscaler's
+        queue-depth signal; cheaper than a full status())."""
+        with self._lock:
+            return len(self._requests)
+
+    def replica_names(self) -> list[str]:
+        with self._lock:
+            return [r.name for r in self._replicas]
+
+    def add_replica(self, spec) -> str:
+        """Admit a new member (fleet verb `add` / supervisor respawn).
+        The connect is attempted inline (bounded by connect_timeout_s);
+        a member that is not up yet simply stays down until a health
+        tick reaches it.  Returns the member name; raises ValueError on
+        a bad spec or duplicate membership, RouterClosed after close()."""
+        host, port = parse_replica_spec(spec)
+        name = f"{host}:{port}"
+        with self._lock:
+            if self._down:
+                raise RouterClosed("router is shutting down")
+            if name in self._by_name:
+                raise ValueError(f"replica {name} is already a member")
+            self._replica_seq += 1
+            replica = _Replica(self._replica_seq - 1, host, port)
+            self._replicas.append(replica)
+            self._by_name[name] = replica
+        self._try_connect(replica)
+        self._log.info(f"router: replica {name} joined the fleet")
+        return name
+
+    def remove_replica(self, name: str, drain: bool = True,
+                       timeout_s: float = 30.0) -> dict:
+        """Retire a member through the proven drain path: routing to it
+        stops immediately, its sticky homes migrate, and its in-flight
+        requests complete in place (bounded by `timeout_s`) -- anything
+        still parked past the deadline (or with drain=False) fails over
+        to the rest of the fleet via the shared sweep transaction, so
+        removal never loses a request.  Refuses to remove the last
+        member unless the router allows an empty fleet (supervised
+        mode).  Returns {"replica", "drained", "failed_over"}."""
+        with self._lock:
+            replica = self._by_name.get(name)
+            if replica is None:
+                raise ValueError(f"replica {name} is not a member")
+            if len(self._replicas) <= 1 and not self.config.allow_empty:
+                raise ValueError(
+                    "cannot remove the last replica (in-flight work "
+                    "would have no failover target)")
+            replica.draining = True            # no new routes from here on
+            self._sticky.forget_member(name)   # homes migrate now
+        drained = True
+        if drain:
+            deadline = time.monotonic() + max(float(timeout_s), 0.0)
+            while True:
+                with self._lock:
+                    if not replica.inflight:
+                        break
+                if time.monotonic() > deadline:
+                    drained = False
+                    break
+                time.sleep(0.01)
+        with self._lock:
+            # the remainder (drain=False, deadline hit, or replies that
+            # raced the sweep) moves to the surviving members
+            moved = self._sweep_inflight_locked(replica)
+            if self._by_name.get(name) is replica:
+                del self._by_name[name]
+            try:
+                self._replicas.remove(replica)
+            except ValueError:
+                pass
+            link, replica.link = replica.link, None
+            if link is not None:
+                # the close below FINs the reader thread into
+                # _on_link_lost; marking the link failed here makes that
+                # sweep a no-op (the member is already gone -- a health
+                # strike now would haunt a future member of this name)
+                link.failed = True
+            replica.probe_id = None
+            self._health.forget(name)
+        for req in moved:
+            self._dispatch(req)
+        if link is not None:
+            link.close()
+        self._log.info(
+            f"router: replica {name} left the fleet "
+            f"({'drained clean' if drained else 'drain deadline hit'}, "
+            f"{len(moved)} request(s) failed over)")
+        return {"replica": name, "drained": drained,
+                "failed_over": len(moved)}
 
     # ------------------------------------------------------------ submission
 
@@ -674,8 +821,11 @@ class CcsRouter:
         req.done = True
         self._requests.pop(req.rid, None)
         if req.assigned is not None:
-            owner = self._by_name[req.assigned]
-            if owner.inflight.pop(req.rid, None) is not None:
+            # .get: the owner may have left the fleet (remove_replica)
+            # between assignment and this completion
+            owner = self._by_name.get(req.assigned)
+            if owner is not None \
+                    and owner.inflight.pop(req.rid, None) is not None:
                 owner.m_inflight.set(owner.depth())
         self._completed_total += 1
 
@@ -808,19 +958,24 @@ class CcsRouter:
 
     # --------------------------------------------------------------- health
 
-    def _try_connect(self, replica: _Replica) -> None:
+    def _try_connect(self, replica: _Replica) -> bool:
+        """One blocking connect attempt; False ONLY on a refused/failed
+        connect (the signal the reconnect backoff doubles on) -- a stale
+        attempt (already connected, shut down, or the member left the
+        fleet) is not a failure."""
         try:
             sock = socket.create_connection(
                 (replica.host, replica.port),
                 timeout=self.config.connect_timeout_s)
         except OSError:
-            return  # stays down; routing skips it, next tick retries
+            return False  # stays down; the next due tick retries
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
         link = ReplicaLink(self, replica, sock)
         with self._lock:
-            if self._down or replica.link is not None:
+            if self._down or replica.link is not None \
+                    or self._by_name.get(replica.name) is not replica:
                 stale = True
             else:
                 stale = False
@@ -834,13 +989,18 @@ class CcsRouter:
                 replica.external_backlog = 0
         if stale:
             link.close()
-            return
+            return True
         link.start()
         self._log.info(f"router: connected to replica {replica.name}")
+        return True
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.config.health_interval_s):
-            for replica in self._replicas:
+            # snapshot under the lock: membership changes mid-tick
+            # (fleet add/remove) must not race this iteration
+            with self._lock:
+                replicas = list(self._replicas)
+            for replica in replicas:
                 self._probe(replica)
 
     def _probe(self, replica: _Replica) -> None:
@@ -856,16 +1016,34 @@ class CcsRouter:
             # down replica (up to connect_timeout_s) would stretch the
             # probe cadence for every HEALTHY replica behind it
             with self._lock:
-                if replica.connecting or self._down:
+                if replica.connecting or self._down \
+                        or self._by_name.get(replica.name) is not replica:
+                    return  # busy, shutting down, or left the fleet
+                if now < replica.reconnect_at:
+                    # exponential backoff window still open: count the
+                    # skipped attempt, don't hammer a dead port
+                    replica.m_reconnect_backoff.inc()
                     return
                 replica.connecting = True
 
             def attempt(replica=replica):
+                ok = False
                 try:
-                    self._try_connect(replica)
+                    ok = self._try_connect(replica)
                 finally:
                     with self._lock:
                         replica.connecting = False
+                        if ok:
+                            replica.reconnect_backoff_s = 0.0
+                            replica.reconnect_at = 0.0
+                        else:
+                            base = self.config.reconnect_backoff_base_s
+                            replica.reconnect_backoff_s = min(
+                                self.config.reconnect_backoff_cap_s,
+                                max(base, replica.reconnect_backoff_s * 2))
+                            replica.reconnect_at = (
+                                time.monotonic()
+                                + replica.reconnect_backoff_s)
 
             threading.Thread(
                 target=attempt, daemon=True,
@@ -1079,9 +1257,10 @@ class CcsRouter:
                 "failovers": r.failovers,
             } for r in self._replicas]
             ledger = self._ledger
+            supervisor = self._supervisor
             perf = {protocol.FIELD_PERF: ledger.perf_block()} \
                 if ledger is not None else {}
-            return {
+            out = {
                 "engine": "ccs-router",
                 **perf,
                 "accepting": self._accepting,
@@ -1093,6 +1272,13 @@ class CcsRouter:
                 "deduped": self._dedup_total,
                 "replicas": replicas,
             }
+        if supervisor is not None:
+            # OUTSIDE the router lock: supervisor threads call
+            # add_replica/remove_replica (which take the router lock)
+            # while holding their own -- nesting the other way here
+            # would be a lock-order inversion
+            out[protocol.FIELD_SUPERVISOR] = supervisor.status_block()
+        return out
 
     def metrics_text(self) -> str:
         """FEDERATED fleet exposition: the router's own registry plus
@@ -1173,6 +1359,94 @@ class _RouterSession(_FramedSession):
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST,
                 'trace.action must be "start" or "stop"'))
+
+    def _on_fleet(self, msg: dict) -> None:
+        self.send(self._fleet_reply(msg))
+
+    def _fleet_reply(self, msg: dict) -> dict:
+        """Compute (never send) the reply to a fleet admin verb --
+        membership surgery on the live router: list / add / remove run
+        directly against the routing table; restart / readmit need the
+        supervising control plane (`ccs fleet`) and are refused on an
+        unsupervised router."""
+        rid = msg.get("id")
+        action = msg.get("action")
+        router: CcsRouter = self.server.engine
+        if action == "list":
+            status = router.status()
+            reply = {"type": protocol.TYPE_FLEET, "id": rid,
+                     "action": action, "ok": True,
+                     "replicas": status["replicas"]}
+            if protocol.FIELD_SUPERVISOR in status:
+                reply[protocol.FIELD_SUPERVISOR] = \
+                    status[protocol.FIELD_SUPERVISOR]
+            return reply
+        if action == "add":
+            spec = msg.get("replica")
+            if not isinstance(spec, str):
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST,
+                    "fleet.add needs a replica HOST:PORT string")
+            try:
+                name = router.add_replica(spec)
+            except RouterClosed as e:
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_CLOSED, str(e))
+            except ValueError as e:
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST, str(e))
+            return {"type": protocol.TYPE_FLEET, "id": rid,
+                    "action": action, "ok": True, "replica": name}
+        if action == "remove":
+            spec = msg.get("replica")
+            if not isinstance(spec, str):
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST,
+                    "fleet.remove needs a replica HOST:PORT string")
+            timeout_s = msg.get("timeout_s", 30.0)
+            if not isinstance(timeout_s, (int, float)) \
+                    or isinstance(timeout_s, bool):
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST,
+                    "fleet.timeout_s must be a number")
+            try:
+                out = router.remove_replica(
+                    spec, drain=bool(msg.get("drain", True)),
+                    timeout_s=float(timeout_s))
+            except ValueError as e:
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST, str(e))
+            return {"type": protocol.TYPE_FLEET, "id": rid,
+                    "action": action, "ok": True, **out}
+        if action in ("restart", "readmit"):
+            supervisor = router.get_supervisor()
+            if supervisor is None:
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST,
+                    f"fleet.{action} needs a fleet supervisor "
+                    "(`ccs fleet`); this router is unsupervised")
+            if action == "restart":
+                started = supervisor.request_rolling_restart()
+                return {"type": protocol.TYPE_FLEET, "id": rid,
+                        "action": action, "ok": True,
+                        "state": "started" if started
+                        else "already_running"}
+            slot = msg.get("slot")
+            if not isinstance(slot, int) or isinstance(slot, bool):
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST,
+                    "fleet.readmit needs an integer slot")
+            try:
+                supervisor.readmit(slot)
+            except ValueError as e:
+                return protocol.error_to_wire(
+                    rid, protocol.ERR_BAD_REQUEST, str(e))
+            return {"type": protocol.TYPE_FLEET, "id": rid,
+                    "action": action, "ok": True, "slot": slot}
+        return protocol.error_to_wire(
+            rid, protocol.ERR_BAD_REQUEST,
+            'fleet.action must be "list", "add", "remove", '
+            '"restart" or "readmit"')
 
 
 class RouterServer(CcsServer):

@@ -26,6 +26,7 @@ is visible before it is a problem.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import socket
 import sys
@@ -146,6 +147,14 @@ class _FramedSession:
             msg.get("id"), protocol.ERR_BAD_REQUEST,
             "trace is not supported by this front door"))
 
+    def _on_fleet(self, msg: dict) -> None:
+        # fleet membership administration is a ROUTER verb; the local
+        # serve front door rejects it structurally (the router session
+        # subclass overrides this with the real implementation)
+        self.send(protocol.error_to_wire(
+            msg.get("id"), protocol.ERR_BAD_REQUEST,
+            "fleet is not supported by this front door"))
+
     def _parse_submit(self, msg: dict):
         """Shared submit decode: validated (chunk, deadline, trace
         context), or None after a structured `bad_request` reply (the
@@ -198,6 +207,8 @@ class _FramedSession:
             self._on_metrics(msg)
         elif verb == protocol.VERB_TRACE:
             self._on_trace(msg)
+        elif verb == protocol.VERB_FLEET:
+            self._on_fleet(msg)
         elif verb == protocol.VERB_PING:
             self.send({"type": protocol.TYPE_PONG, "id": msg.get("id")})
         else:
@@ -564,6 +575,13 @@ def run_serve(argv: list[str] | None = None) -> int:
 
     if args.faults is not None:
         faults.configure(args.faults, seed=args.faultSeed)
+    # fault site: fires before the engine exists, so an armed
+    # `serve.start:crashloop` spec kills the replica instantly (the
+    # supervisor's quarantine path is chaos-testable without a broken
+    # build).  Keys on the fleet slot the supervisor exports, so a
+    # `~N` modifier targets one slot of a homogeneous fleet.
+    faults.maybe_fail("serve.start",
+                      keys=(os.environ.get("PBCCS_FLEET_SLOT", ""),))
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 

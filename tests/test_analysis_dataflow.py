@@ -509,7 +509,7 @@ def test_wire_spec_parses_from_live_protocol():
     spec, err = parse_spec(proto)
     assert err is None
     assert set(spec.verbs) == {"submit", "status", "metrics", "trace",
-                               "ping"}
+                               "ping", "fleet"}
     assert "closed" in spec.replies
     assert spec.errors == {"bad_request", "overloaded", "closed",
                            "internal"}

@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""Autopilot smoke for the tier-1 gate: a supervised `ccs fleet` under
+streamed load, with chaos aimed at the CONTROL plane.
+
+tools/fleet_smoke.py proves the data plane (router + replicas, zero
+loss on kill -9 / drain).  This gate proves the autopilot above it --
+the supervisor must keep the fleet serving, elastic, and upgradeable
+without losing a single request:
+
+  kill9     2-replica fleet, 12 requests in flight; one replica's child
+            process is kill -9'd via the pid the supervisor publishes:
+            zero lost / zero duplicated (raw-frame counting), answers
+            byte-identical to offline process_chunks, the slot respawns
+            under a NEW port and rejoins the routing table (respawn +
+            add fleet_events in the perf ledger)
+  scale     a doubled workload sustains router queue depth past the
+            burn threshold: a THIRD slot spawns (scale_up), then the
+            idle fleet retires it again by a proven drain (scale_down,
+            active slots back to 2)
+  rolling   `fleet restart` is issued mid-stream: every slot cycles
+            (drain -> SIGTERM -> respawn warm -> health gate), replies
+            stay byte-identical to offline, rolling_restart_begin/
+            _step/_done land in the ledger
+  crashloop a second fleet arms `serve.start:crashloop~1` fault
+            injection: slot 1's child dies at every spawn, the
+            supervisor quarantines it after K rapid deaths (state
+            `dead` with a structured crash-loop reason, rendered by
+            `ccs top`), and the surviving slot serves the full
+            workload byte-identically
+
+The workload reuses the chaos-cell geometry (tpl 60, 5 passes, seed
+20260803), so compiled shapes come warm from the checkout-local
+compile cache the earlier smokes populated -- which is also what makes
+respawned replicas "warm-started" rather than recompiling.
+
+Run:  JAX_PLATFORMS=cpu python tools/autopilot_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")   # repo root (pbccs_tpu)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import fleet_smoke
+from fleet_smoke import (artifacts_dir, check, make_workload, router_status,
+                         router_verb, run_leg, spawn_ready, wait_for_victim)
+
+N_ZMWS = fleet_smoke.N_ZMWS
+
+
+def spawn_fleet(extra: list[str], ledger: str,
+                faults: str | None = None):
+    """One `ccs fleet` control-plane subprocess, ready to administer.
+    `faults` rides the environment so the spec reaches the CHILD
+    processes the supervisor spawns (the fleet process itself has no
+    armed serve.start site)."""
+    argv = ["fleet", "--port", "0", "--logLevel", "ERROR",
+            "--routerHealthInterval", "0.3", "--routerHealthTimeout", "3",
+            "--readyTimeout", "300", "--perfLedger", ledger,
+            "--serveArg=--maxBatch=4", "--serveArg=--maxWaitMs=250",
+            "--serveArg=--drainTimeout=300"] + extra
+    if faults is not None:
+        os.environ["PBCCS_FAULTS"] = faults
+    try:
+        proc, port, _pre = spawn_ready(argv, "CCS-FLEET-READY")
+    finally:
+        os.environ.pop("PBCCS_FAULTS", None)
+    return proc, port
+
+
+def supervisor_block(port: int) -> dict:
+    return router_status(port).get("supervisor", {})
+
+
+def slots_by_state(port: int) -> dict[int, dict]:
+    return {s["slot"]: s for s in supervisor_block(port).get("slots", ())}
+
+
+def wait_slots(port: int, want, deadline_s: float = 240.0,
+               label: str = "") -> dict[int, dict]:
+    """Block until `want(slots_dict)` holds; return the slot table."""
+    t0 = time.monotonic()
+    slots: dict[int, dict] = {}
+    while time.monotonic() - t0 < deadline_s:
+        slots = slots_by_state(port)
+        if want(slots):
+            return slots
+        time.sleep(0.25)
+    raise SystemExit(f"autopilot smoke: timeout waiting for {label}: "
+                     f"{json.dumps(list(slots.values()))}")
+
+
+def ledger_events(path: str) -> list[str]:
+    names = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "fleet_event":
+                    names.append(rec["fleet_event"])
+    except OSError:
+        pass
+    return names
+
+
+def terminate_fleet(proc: subprocess.Popen, label: str) -> None:
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=420)
+        check(f"{label}: fleet exited 0 on SIGTERM", rc == 0,
+              f"exit {rc}")
+
+
+def main() -> int:
+    from pbccs_tpu.pipeline import ConsensusSettings, process_chunks
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+    from pbccs_tpu.runtime.logging import Logger, LogLevel
+
+    enable_compilation_cache()
+    Logger.default(Logger(level=LogLevel.ERROR))
+    chunks, wires = make_workload()
+    out_dir = artifacts_dir()
+    ledger_a = os.path.join(out_dir, "autopilot_fleet.ndjson")
+    ledger_b = os.path.join(out_dir, "autopilot_crashloop.ndjson")
+    for p in (ledger_a, ledger_b):
+        if os.path.exists(p):
+            os.unlink(p)
+
+    print("== baseline (offline process_chunks) ==", flush=True)
+    t0 = time.monotonic()
+    offline = process_chunks(list(chunks), ConsensusSettings())
+    offline_out = {r.id: (r.sequence, r.qualities)
+                   for r in offline.results}
+    check("baseline yields all successes", len(offline_out) == N_ZMWS,
+          f"{len(offline_out)}/{N_ZMWS} in {time.monotonic() - t0:.0f}s")
+
+    proc, port = spawn_fleet(
+        ["--replicas", "2", "--minReplicas", "2", "--maxReplicas", "3",
+         "--scaleUpPending", "6", "--scaleUpSustain", "1",
+         "--scaleDownIdle", "4", "--backoffBase", "0.2",
+         "--drainTimeout", "300", "--crashloopThreshold", "3"],
+        ledger_a)
+    try:
+        wait_slots(port, lambda s: len(s) == 2 and all(
+            v["state"] == "up" for v in s.values()), label="2 slots up")
+
+        print("== leg: child kill -9 -> respawn under a new port ==",
+              flush=True)
+        killed: dict = {}
+
+        def kill9():
+            victim = wait_for_victim(port)
+            slots = slots_by_state(port)
+            slot = next(s for s in slots.values()
+                        if s["replica"] == victim)
+            os.kill(slot["pid"], signal.SIGKILL)
+            killed.update(slot)
+            print(f"  kill -9 slot {slot['slot']} "
+                  f"(pid {slot['pid']}, {victim})", flush=True)
+
+        results = run_leg("kill9", port, wires, "k", kill9)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("kill9: byte-identical to offline", got == offline_out)
+        slots = wait_slots(
+            port, lambda s: s.get(killed["slot"], {}).get("state") == "up"
+            and s[killed["slot"]]["pid"] != killed["pid"],
+            label="killed slot respawned")
+        check("kill9: slot respawned under a NEW replica identity",
+              slots[killed["slot"]]["replica"] != killed["replica"],
+              f"{killed['replica']} -> {slots[killed['slot']]['replica']}")
+        evs = ledger_events(ledger_a)
+        check("kill9: respawn + add fleet_events in the ledger",
+              "respawn" in evs and evs.count("add") >= 3, str(evs))
+
+        print("== leg: load ramp scales up, idle drains back down ==",
+              flush=True)
+        doubled = list(wires) * 2
+        results = run_leg("scale", port, doubled, "s", lambda: None)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("scale: byte-identical to offline", got == offline_out)
+        slots = wait_slots(port, lambda s: len(s) >= 3,
+                           label="third slot spawned")
+        check("scale: scale_up decision in the ledger",
+              "scale_up" in ledger_events(ledger_a))
+        wait_slots(
+            port, lambda s: sum(1 for v in s.values()
+                                if v["state"] == "up") == 2
+            and any(v["state"] == "stopped" for v in s.values()),
+            label="idle slot retired by drain")
+        check("scale: scale_down decision in the ledger",
+              "scale_down" in ledger_events(ledger_a))
+
+        print("== leg: rolling restart mid-stream ==", flush=True)
+        pids_before = {s["slot"]: s["pid"]
+                       for s in slots_by_state(port).values()
+                       if s["state"] == "up"}
+
+        def rolling():
+            rr = router_verb(port, {"verb": "fleet", "id": "rr",
+                                    "action": "restart"})
+            check("rolling: restart accepted",
+                  rr.get("state") == "started", str(rr))
+            print("  rolling restart begun mid-stream", flush=True)
+
+        results = run_leg("rolling", port, wires, "r", rolling)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("rolling: byte-identical to offline", got == offline_out)
+        wait_slots(
+            port, lambda s: "rolling_restart_done"
+            in ledger_events(ledger_a)
+            and all(v["state"] in ("up", "stopped")
+                    for v in s.values()),
+            label="rolling restart done")
+        evs = ledger_events(ledger_a)
+        check("rolling: begin/step/done in the ledger",
+              "rolling_restart_begin" in evs
+              and evs.count("rolling_restart_step") >= 2
+              and "rolling_restart_done" in evs, str(evs))
+        pids_after = {s["slot"]: s["pid"]
+                      for s in slots_by_state(port).values()
+                      if s["state"] == "up"}
+        cycled = [sid for sid in pids_before
+                  if pids_after.get(sid) not in (None,
+                                                 pids_before[sid])]
+        check("rolling: every up slot runs a NEW child process",
+              len(cycled) == len(pids_before),
+              f"cycled {cycled} of {sorted(pids_before)}")
+
+        terminate_fleet(proc, "autopilot")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+    print("== leg: crash-looping replica is quarantined ==", flush=True)
+    proc, port = spawn_fleet(
+        ["--replicas", "2", "--backoffBase", "0.1",
+         "--crashloopThreshold", "3", "--crashloopWindow", "60",
+         "--drainTimeout", "300"],
+        ledger_b, faults="serve.start:crashloop~1")
+    try:
+        slots = wait_slots(
+            port, lambda s: s.get(1, {}).get("state") == "dead"
+            and s.get(0, {}).get("state") == "up",
+            label="slot 1 quarantined, slot 0 up")
+        check("crashloop: structured quarantine reason",
+              "crash-loop" in slots[1]["reason"], slots[1]["reason"])
+        check("crashloop: quarantine fleet_event in the ledger",
+              "quarantine" in ledger_events(ledger_b))
+
+        # the operator view tells a dead slot from a live one
+        top = subprocess.run(
+            [sys.executable, "-m", "pbccs_tpu.cli", "top",
+             f"127.0.0.1:{port}", "--once", "--format", "json"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120)
+        check("crashloop: ccs top --once exits 0", top.returncode == 0,
+              top.stderr[-300:])
+        view = json.loads(top.stdout)
+        states = {r.get("slot"): r.get("slot_state")
+                  for r in view["replicas"] if "slot" in r}
+        check("crashloop: ccs top renders the dead slot",
+              states.get(1) == "dead", str(states))
+        check("crashloop: ccs top renders the live slot",
+              states.get(0) == "up", str(states))
+
+        # the crippled fleet still answers EVERYTHING, correctly
+        results = run_leg("crashloop", port, wires, "c", lambda: None)
+        got = {m["zmw"]: (m["sequence"], m["qual"])
+               for m in results.values()}
+        check("crashloop: byte-identical to offline", got == offline_out)
+
+        terminate_fleet(proc, "crashloop")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+    print(f"  artifacts: {ledger_a} "
+          f"({len(ledger_events(ledger_a))} fleet events), {ledger_b} "
+          f"({len(ledger_events(ledger_b))} fleet events)", flush=True)
+    print("autopilot smoke: all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
