@@ -1054,6 +1054,161 @@ def bench_router() -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_noisy_neighbor() -> dict:
+    """Multi-tenant fairness A/B: tenant A saturates a 1-replica fleet
+    while tenant B submits its cell, with the per-tenant fair queue OFF
+    (no tenancy: both share one FIFO admission path) then ON (A
+    quota-bound at 2 in flight, B priority 0 / weight 2).  The figure
+    is tenant_b_p99_gain = B's p99 OFF / ON -- how much contention
+    latency the weighted-fair admission takes off the victim tenant.
+    Each phase also lands a kind="tenant_snapshot" perf-ledger row per
+    tenant (tenant_p99_ms under contention), and the gain backs the
+    PERF_BASELINE.json floor (wall-class: enforced on matching
+    accelerator platforms, recorded-only on CPU CI)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.obs.metrics import MeasurementScope, default_registry
+    from pbccs_tpu.serve.client import CcsClient, ServeError
+    from pbccs_tpu.serve.router import CcsRouter, RouterConfig, RouterServer
+    from pbccs_tpu.serve.tenancy import Tenant, TenantDirectory
+    from pbccs_tpu.simulate import simulate_zmw
+
+    n_b = int(os.environ.get("BENCH_TENANT_ZMWS", 12))
+    tpl_len = int(os.environ.get("BENCH_TENANT_TPL_LEN", 120))
+    passes = int(os.environ.get("BENCH_TENANT_PASSES", 6))
+    flood_window = int(os.environ.get("BENCH_TENANT_FLOOD_WINDOW", 12))
+
+    rng = np.random.default_rng(20260803)
+    cells = {}
+    for tenant, n in (("tenantB", n_b), ("tenantA", 4)):
+        zmws = []
+        for i in range(n):
+            _, reads, _, snr = simulate_zmw(rng, tpl_len, passes)
+            zmws.append({"id": f"{tenant}/{i}",
+                         "snr": [float(s) for s in snr],
+                         "reads": [{"seq": decode_bases(r)} for r in reads]})
+        cells[tenant] = zmws
+
+    tok_a, tok_b = "bench-tenant-a", "bench-tenant-b"
+
+    def flood_a(host, port, token, stop, counts):
+        """Sustained saturation from tenant A: keep `flood_window`
+        submits in flight, resubmitting forever; quota rejects are the
+        fair queue doing its job (counted, briefly backed off)."""
+        with CcsClient(host, port, auth_token=token) as cli:
+            pending = []
+            i = 0
+            while not stop.is_set():
+                try:
+                    while len(pending) < flood_window and not stop.is_set():
+                        zmw = cells["tenantA"][i % len(cells["tenantA"])]
+                        pending.append(cli.submit_wire(
+                            dict(zmw, id=f"{zmw['id']}#{i}")))
+                        i += 1
+                    if pending:
+                        pending.pop(0).reply(timeout=600.0)
+                        counts["completed"] += 1
+                except ServeError:
+                    counts["rejected"] += 1
+                    time.sleep(0.005)
+                except (ConnectionError, TimeoutError):
+                    return
+            for h in pending:
+                try:
+                    h.reply(timeout=600.0)
+                    counts["completed"] += 1
+                except (ServeError, ConnectionError, TimeoutError):
+                    pass
+
+    def phase(port, tenants):
+        """B's per-request latencies while A floods; (b_lat_ms, a_counts)."""
+        router = CcsRouter([f"127.0.0.1:{port}"],
+                           RouterConfig(health_interval_s=1.0),
+                           tenants=tenants).start()
+        server = RouterServer(router, port=0, tenants=tenants).start()
+        stop = threading.Event()
+        counts = {"completed": 0, "rejected": 0}
+        flooder = threading.Thread(
+            target=flood_a, args=(server.host, server.port,
+                                  tok_a if tenants else None, stop, counts))
+        lat_ms = []
+        try:
+            flooder.start()
+            time.sleep(0.5)  # let A's flood occupy the fleet first
+            with CcsClient(server.host, server.port,
+                           auth_token=tok_b if tenants else None) as cli:
+                for zmw in cells["tenantB"]:
+                    t0 = time.monotonic()
+                    cli.submit_wire(zmw).reply(timeout=600.0)
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+        finally:
+            stop.set()
+            flooder.join(timeout=600.0)
+            server.shutdown()
+            router.close()
+        return lat_ms, counts
+
+    cache_dir = tempfile.mkdtemp(prefix="pbccs_tenant_cache_")
+    proc = None
+    scope = MeasurementScope(default_registry())
+    try:
+        proc, port = _spawn_serve_replica(cache_dir, ["--maxBatch", "4"])
+        # warm the serve buckets so neither phase pays a cold compile
+        _drive_router("127.0.0.1", port, cells["tenantB"], 2, 4)
+
+        lat_off, a_off = phase(port, None)
+        directory = TenantDirectory([
+            Tenant("tenantA", tok_a, max_inflight=2, priority=1),
+            Tenant("tenantB", tok_b, max_inflight=8, priority=0, weight=2),
+        ])
+        lat_on, a_on = phase(port, directory)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    p99_off = float(np.percentile(np.asarray(lat_off), 99))
+    p99_on = float(np.percentile(np.asarray(lat_on), 99))
+    gain = round(p99_off / p99_on, 4) if p99_on else None
+
+    if os.environ.get("BENCH_PERF_LEDGER"):
+        from pbccs_tpu.obs.ledger import PerfLedger, run_record
+
+        workload = {"n_zmws": n_b, "tpl_len": tpl_len, "n_passes": passes}
+        ledger = PerfLedger(os.environ["BENCH_PERF_LEDGER"])
+        for tenant, prio, p99 in (("tenantA", 1, None),
+                                  ("tenantB", 0, p99_on)):
+            extra = {"tenant": tenant, "tenant_priority": prio}
+            if p99 is not None:
+                extra["tenant_p99_ms"] = round(p99, 1)
+                if gain is not None:
+                    extra["tenant_b_p99_gain"] = gain
+            ledger.append(run_record(
+                scope, kind="tenant_snapshot",
+                source="bench_noisy_neighbor", workload=workload,
+                extra=extra))
+        ledger.close()
+
+    return {
+        "name": "serve_noisy_neighbor",
+        "n_zmws_b": n_b, "tpl_len": tpl_len, "n_passes": passes,
+        "flood_window": flood_window, "host_cpus": os.cpu_count(),
+        "tenant_b_p99_ms_fair_off": round(p99_off, 1),
+        "tenant_b_p99_ms_fair_on": round(p99_on, 1),
+        "tenant_b_p99_gain": gain,
+        "tenant_a_fair_off": a_off, "tenant_a_fair_on": a_on,
+        "note": "gain = victim p99 fairness-off / fairness-on under a "
+                "sustained 1-replica flood; CPU subprocesses share host "
+                "cores, so the accelerator gain is a lower bound",
+    }
+
+
 def bench_warm_restart() -> dict:
     """Rolling-restart cost with the persistent compile cache: `ccs
     warmup --compileCache DIR` twice against a FRESH dir.  The first run
@@ -1338,7 +1493,8 @@ def main() -> None:
                 ref_cfgs = json.load(f).get("configs", {})
         configs = bench_sweep(ref_cfgs)
         for extra in (bench_quiver, bench_streamed, bench_full_cell,
-                      bench_sched, bench_router, bench_warm_restart):
+                      bench_sched, bench_router, bench_noisy_neighbor,
+                      bench_warm_restart):
             try:
                 configs.append(extra())
             except Exception as e:  # noqa: BLE001

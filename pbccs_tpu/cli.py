@@ -124,7 +124,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ccs", description=DESCRIPTION,
         epilog="`ccs serve [OPTIONS]` starts the long-lived online serving "
                "engine instead, and `ccs router [OPTIONS]` the "
-               "multi-replica front door over N serve processes (see "
+               "multi-replica front door over N serve processes; both "
+               "take --tlsCert/--tlsKey/--authTokens for a TLS + "
+               "token-authenticated multi-tenant edge (see "
                "`ccs serve --help` / `ccs router --help`).")
     p.add_argument("--version", action="version", version=__version__)
     p.add_argument("--zmws", default="all",

@@ -57,6 +57,15 @@ def build_top_parser() -> argparse.ArgumentParser:
                    help="Per-poll reply timeout; an unanswered poll "
                         "marks the target unreachable for that frame. "
                         "Default = %(default)s")
+    # multi-tenant edge: reach a TLS'd / token-guarded fleet
+    p.add_argument("--tlsCa", default=None, metavar="PEM",
+                   help="CA bundle verifying the target's certificate; "
+                        "also switches the poll connection to TLS.")
+    p.add_argument("--tls", action="store_true",
+                   help="TLS without CA pinning (encrypted, "
+                        "unauthenticated; prefer --tlsCa).")
+    p.add_argument("--authToken", default=None, metavar="TOKEN",
+                   help="Bearer token for a token-guarded target.")
     return p
 
 
@@ -70,14 +79,16 @@ def _parse_target(target: str) -> tuple[str, int]:
         raise ValueError(f"target {target!r}: want HOST:PORT") from None
 
 
-def sample(host: str, port: int, timeout: float = 5.0
-           ) -> dict[str, Any] | None:
+def sample(host: str, port: int, timeout: float = 5.0,
+           tls_ca: str | None = None, tls: bool = False,
+           auth_token: str | None = None) -> dict[str, Any] | None:
     """One poll: the target's status verb + parsed metrics exposition,
     or None when the target is unreachable (absence, not crash)."""
     from pbccs_tpu.serve.client import CcsClient
 
     try:
-        with CcsClient(host, port, timeout=timeout) as cli:
+        with CcsClient(host, port, timeout=timeout, tls_ca=tls_ca,
+                       tls=tls, auth_token=auth_token) as cli:
             status = cli.status(timeout=timeout)
             metrics = parse_exposition(cli.metrics(timeout=timeout))
     except (OSError, TimeoutError, RuntimeError):
@@ -188,10 +199,14 @@ def fleet_view(cur: dict, prev: dict | None, target: str
                                          prev, dt, roster=roster))
         fleet = {k: status.get(k) for k in
                  ("accepting", "pending", "routed", "completed",
-                  "failovers", "deduped", "uptime_s")}
+                  "failovers", "deduped", "shed", "uptime_s")}
         supervisor = status.get("supervisor")
         if supervisor:
             _merge_supervisor(replicas, supervisor, fleet)
+        tenancy = status.get("tenancy")
+        if tenancy:
+            # the router's per-tenant fair-queue accounting, verbatim
+            fleet["tenancy"] = tenancy
     else:
         replicas.append(_replica_row(None, metrics, prev, dt))
         fleet = {k: status.get(k) for k in
@@ -286,6 +301,21 @@ def render_text(view: dict[str, Any]) -> str:
             f"{_fmt(ref.get('slot_occupancy'), 6, 3)} "
             f"{_fmt(ref.get('padding_waste'), 6, 3)} "
             f"{_fmt(rl.get('efficiency'), 9, 6)}")
+    tenancy = view["fleet"].get("tenancy")
+    if tenancy:
+        shedding = " [SHEDDING]" if tenancy.get("shedding") else ""
+        lines.append(
+            f"tenants  burn={_fmt(tenancy.get('burn_rate'), 0, 4).strip()}"
+            f"{shedding}")
+        lines.append(
+            f"  {'TENANT':<16} {'PRI':>3} {'WT':>3} {'INFLT':>6} "
+            f"{'QUEUED':>6} {'DONE':>8} {'REJ':>6} {'SHED':>6}")
+        for t in tenancy.get("tenants", ()):
+            lines.append(
+                f"  {t.get('name', '?'):<16} {_fmt(t.get('priority'), 3)} "
+                f"{_fmt(t.get('weight'), 3)} {_fmt(t.get('inflight'), 6)} "
+                f"{_fmt(t.get('queued'), 6)} {_fmt(t.get('completed'), 8)} "
+                f"{_fmt(t.get('rejected'), 6)} {_fmt(t.get('shed'), 6)}")
     rolling = view["fleet"].get("rolling_restart")
     if rolling:
         lines.append(
@@ -303,11 +333,14 @@ def render_text(view: dict[str, Any]) -> str:
 
 
 def top_frame(host: str, port: int, target: str, prev: dict | None,
-              timeout: float) -> tuple[dict | None, dict | None]:
+              timeout: float, tls_ca: str | None = None,
+              tls: bool = False, auth_token: str | None = None
+              ) -> tuple[dict | None, dict | None]:
     """One console frame: (view, sample) — view None when the target is
     unreachable (the sample is then also None, and the next frame
     restarts its throughput window)."""
-    cur = sample(host, port, timeout=timeout)
+    cur = sample(host, port, timeout=timeout, tls_ca=tls_ca, tls=tls,
+                 auth_token=auth_token)
     if cur is None:
         return None, None
     return fleet_view(cur, prev, target), cur
@@ -323,13 +356,15 @@ def run_top(argv: list[str] | None = None) -> int:
         return 2
     interval = max(args.interval, 0.1)
 
+    edge = {"tls_ca": args.tlsCa, "tls": args.tls,
+            "auth_token": args.authToken}
     if args.once:
         # two quick samples so throughput is a measured rate, not null
-        prev = sample(host, port, timeout=args.timeout)
+        prev = sample(host, port, timeout=args.timeout, **edge)
         if prev is not None:
             time.sleep(min(interval, 1.0))
         view, _cur = top_frame(host, port, args.target, prev,
-                               args.timeout)
+                               args.timeout, **edge)
         if view is None:
             msg = {"target": args.target,
                    "error": "target unreachable"}
@@ -347,7 +382,7 @@ def run_top(argv: list[str] | None = None) -> int:
     try:
         while True:
             view, cur = top_frame(host, port, args.target, prev,
-                                  args.timeout)
+                                  args.timeout, **edge)
             prev = cur
             if args.format == "json":
                 out = json.dumps(view if view is not None else

@@ -14,16 +14,21 @@ thread serving
                    503 "draining" once it says no -- a load balancer
                    sees a draining replica before its socket closes
 
-No dependencies, no TLS (the multi-tenant edge is ROADMAP item 4); bind
-it to loopback or a private interface.  Render errors return 500 with
-the error text rather than killing the serving thread, and a scrape
-racing server shutdown gets a connection error on its own socket, never
-a traceback out of the server.
+No dependencies.  With the multi-tenant edge's `--tlsCert/--tlsKey`
+(serve/tenancy.py), the scrape endpoint serves HTTPS with the SAME
+certificate as the NDJSON front door -- a TLS'd fleet has no plaintext
+surface -- and the per-connection TLS handshake runs in the handler
+thread (never the accept loop), so a plaintext scraper probing the
+HTTPS port costs one thread a failed handshake, not the endpoint.
+Render errors return 500 with the error text rather than killing the
+serving thread, and a scrape racing server shutdown gets a connection
+error on its own socket, never a traceback out of the server.
 """
 
 from __future__ import annotations
 
 import http.server
+import ssl
 import threading
 from typing import Callable
 
@@ -79,18 +84,56 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+class _TLSHTTPServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-connection TLS handshake happens in
+    the handler thread: finish_request (already off the accept loop via
+    ThreadingMixIn) wraps the socket, and a failed handshake -- a
+    plaintext client, a bad cert probe, a stall -- quietly closes that
+    one connection.  No traceback, no accept-loop stall."""
+
+    ssl_context: ssl.SSLContext | None = None
+    handshake_timeout_s = 10.0
+
+    def finish_request(self, request, client_address):
+        ctx = self.ssl_context
+        if ctx is not None:
+            request.settimeout(self.handshake_timeout_s)
+            try:
+                request = ctx.wrap_socket(request, server_side=True)
+            except (OSError, ssl.SSLError):
+                try:
+                    request.close()
+                except OSError:
+                    pass
+                return
+            request.settimeout(None)
+        try:
+            super().finish_request(request, client_address)
+        finally:
+            # wrap_socket detached the fd from the socket object the
+            # server will shutdown_request(); close the wrapped one here
+            # or it leaks until GC
+            try:
+                request.close()
+            except OSError:
+                pass
+
+
 def start_metrics_http(render: Callable[[], str], host: str = "127.0.0.1",
                        port: int = 0,
-                       health: Callable[[], bool] | None = None):
+                       health: Callable[[], bool] | None = None,
+                       ssl_context: ssl.SSLContext | None = None):
     """Serve `render()` on GET /metrics in a daemon thread; returns the
     started server (``.server_port`` carries the bound port for port=0,
     ``.shutdown()`` stops it).  `health` (optional) backs /healthz:
-    True -> 200 "ok", False/raise -> 503 "draining"."""
+    True -> 200 "ok", False/raise -> 503 "draining".  `ssl_context`
+    (optional) serves HTTPS instead of HTTP."""
     handler = type("MetricsHandler", (_Handler,),
                    {"render": staticmethod(render),
                     "health": staticmethod(health) if health is not None
                     else None})
-    server = http.server.ThreadingHTTPServer((host, port), handler)
+    server = _TLSHTTPServer((host, port), handler)
+    server.ssl_context = ssl_context
     server.daemon_threads = True
     threading.Thread(target=server.serve_forever, daemon=True,
                      name=f"ccs-metrics-http-{server.server_port}").start()

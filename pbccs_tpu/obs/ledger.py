@@ -65,7 +65,7 @@ LEDGER_FIELDS = {
     "schema_version": "meta",
     "kind": "meta",            # batch_run | bench_row | serve_snapshot |
     #                            router_snapshot | replica_snapshot |
-    #                            fleet_event
+    #                            fleet_event | tenant_snapshot
     "t_unix": "meta",
     "source": "meta",          # emitting process/row identity
     "workload": "meta",        # free-form workload descriptor (dict)
@@ -140,6 +140,17 @@ LEDGER_FIELDS = {
     "slo_violations": "live",
     "queue_depth": "live",
     "replica": "live",
+    # ---- multi-tenant edge (kind == "tenant_snapshot" accounting rows
+    # from the router's fair queue, plus bench noisy-neighbor figures) ----
+    "tenant": "meta",            # tenant name the record is about
+    "tenant_priority": "meta",   # shed class (0 = never shed)
+    "tenant_inflight": "live",
+    "tenant_queued": "live",
+    "tenant_completed": "live",
+    "tenant_sheds": "live",
+    "tenant_rejects": "live",
+    "tenant_p99_ms": "wall",     # per-tenant p99 under contention
+    "tenant_b_p99_gain": "wall",  # victim p99 fairness-off / fairness-on
 }
 
 _reg = default_registry()

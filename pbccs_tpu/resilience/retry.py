@@ -67,13 +67,25 @@ class RetryPolicy:
             retry_on: Callable[[BaseException], bool],
             site: str = "retry",
             rng: np.random.Generator | None = None,
-            sleep: Callable[[float], None] = time.sleep) -> T:
+            sleep: Callable[[float], None] = time.sleep,
+            delay_hint: Callable[[BaseException], float | None]
+            | None = None) -> T:
         """Call fn() with retries on errors retry_on() accepts.
 
         Non-retryable errors propagate untouched.  When attempts or the
         deadline run out, raises RetriesExhausted from the last error
-        (so callers can distinguish "gave up" from "not retryable")."""
+        (so callers can distinguish "gave up" from "not retryable").
+
+        `delay_hint(exc)` (optional) may return a server-supplied
+        backoff in SECONDS (e.g. a shed reply's retry_after_ms): when
+        present it overrides the exponential schedule for the next
+        sleep, capped at max_delay_s and jittered like any other delay,
+        so a shedding fleet paces its clients without letting a hostile
+        hint park them forever.  The schedule still advances underneath,
+        so a later un-hinted error backs off from where the exponential
+        curve would be."""
         counter = _retry_counter(site)
+        rng = rng or np.random.default_rng()
         t0 = time.monotonic()
         last: BaseException | None = None
         delays = self.delays(rng)
@@ -88,6 +100,13 @@ class RetryPolicy:
             delay = next(delays, None)
             if delay is None:
                 break
+            if delay_hint is not None:
+                hint_s = delay_hint(last)
+                if hint_s is not None:
+                    j = (rng.uniform(-self.jitter, self.jitter)
+                         if self.jitter else 0.0)
+                    delay = min(self.max_delay_s,
+                                max(0.0, float(hint_s) * (1.0 + j)))
             if self.deadline_s is not None and \
                     time.monotonic() - t0 + delay > self.deadline_s:
                 break

@@ -6,8 +6,15 @@ out-of-order streamed replies by request id, and `.reply()` blocks the
 caller until that request's result lands.  Thread-safe: any number of
 caller threads may share one client (the load generator runs many).
 
+Security: `tls_ca`/`tls` wrap the session in TLS (tenancy.
+client_ssl_context -- CA-pinned verification, no hostname check), and
+`auth_token` rides every frame as the `auth` bearer token an
+authenticated front door requires.
+
 Resilience: `submit_with_retry` rides out BOTH `overloaded`
-backpressure (jittered exponential backoff) and connection loss -- a
+backpressure (jittered exponential backoff, or the server's
+`retry_after_ms` hint when a shed reply carries one) and connection
+loss -- a
 dropped socket fails the in-flight attempt with ConnectionError, the
 next attempt reconnects to the same endpoint and RESUBMITS the payload
 under a fresh request id (an unacknowledged submit is the client's to
@@ -32,11 +39,15 @@ if TYPE_CHECKING:
 
 
 class ServeError(RuntimeError):
-    """A structured error reply from the server."""
+    """A structured error reply from the server.  `retry_after_ms`
+    carries the server's backoff hint when the reply had one (shed /
+    over-quota rejections); None otherwise."""
 
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str,
+                 retry_after_ms: float | None = None):
         super().__init__(f"{code}: {message}")
         self.code = code
+        self.retry_after_ms = retry_after_ms
 
 
 class PendingReply:
@@ -64,8 +75,12 @@ class PendingReply:
                 f"no reply for request {self.request_id!r}")
         msg = self._msg
         if check and msg.get("type") == protocol.TYPE_ERROR:
+            hint = msg.get(protocol.FIELD_RETRY_AFTER)
+            if not isinstance(hint, (int, float)) or isinstance(hint, bool) \
+                    or hint < 0:
+                hint = None
             raise ServeError(msg.get("code", "unknown"),
-                             msg.get("error", ""))
+                             msg.get("error", ""), retry_after_ms=hint)
         if check and msg.get("type") == "__disconnected__":
             raise ConnectionError("server connection closed mid-stream")
         return msg
@@ -75,9 +90,22 @@ class CcsClient:
     """NDJSON/TCP client for `ccs serve` / `ccs router`
     (context-manager friendly)."""
 
-    def __init__(self, host: str, port: int, timeout: float | None = None):
+    def __init__(self, host: str, port: int, timeout: float | None = None,
+                 tls_ca: str | None = None, tls: bool = False,
+                 auth_token: str | None = None):
+        """`tls_ca` (a CA bundle path) connects over TLS and verifies
+        the server against it; `tls=True` alone encrypts without
+        verification (tests).  `auth_token` attaches the bearer token to
+        EVERY outgoing frame -- the client-side half of the server's
+        --authTokens contract."""
         self._host, self._port = host, port
         self._timeout = timeout
+        self._auth_token = auth_token
+        self._ssl_context = None
+        if tls_ca is not None or tls:
+            from pbccs_tpu.serve import tenancy
+
+            self._ssl_context = tenancy.client_ssl_context(tls_ca)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         self._pending: dict[str, PendingReply] = {}
@@ -110,6 +138,18 @@ class CcsClient:
         sock = socket.create_connection((self._host, self._port),
                                         timeout=30.0)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ssl_context is not None:
+            # handshake under the connect timeout; a TLS failure surfaces
+            # as the same ConnectionError shape a refused connect does
+            try:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=self._host)
+            except OSError as e:  # ssl.SSLError is an OSError
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise ConnectionError(f"TLS handshake failed: {e}") from None
         sock.settimeout(self._timeout)
         self._gen += 1
         self._sock = sock
@@ -144,6 +184,10 @@ class CcsClient:
             self._pending.pop(handle.request_id, None)
 
     def _send(self, msg: dict[str, Any], handle: PendingReply) -> None:
+        if self._auth_token is not None:
+            # every frame authenticates (the server checks per-frame);
+            # one injection point covers every verb
+            msg.setdefault(protocol.FIELD_AUTH, self._auth_token)
         try:
             with self._wlock:
                 # capture (sock, gen) and REGISTER under the write lock:
@@ -276,6 +320,13 @@ class CcsClient:
                     # in-flight slot to a reply nobody consumes)
                     self._discard(handle)
 
+        def hint(e: BaseException) -> float | None:
+            # honor the server's shed/over-quota pacing hint (seconds);
+            # RetryPolicy caps + jitters it, so a hostile hint cannot
+            # park the client and a fleet of clients decorrelates
+            ms = getattr(e, "retry_after_ms", None)
+            return ms / 1e3 if ms is not None else None
+
         return policy.run(
             attempt,
             # a deliberately-closed client must fail fast, not burn the
@@ -284,7 +335,7 @@ class CcsClient:
                                 and not self._closed)
             or (isinstance(e, ServeError)
                 and e.code == protocol.ERR_OVERLOADED),
-            site="client.submit")
+            site="client.submit", delay_hint=hint)
 
     def status(self, timeout: float | None = 30.0) -> dict[str, Any]:
         handle = PendingReply(self._next_id())

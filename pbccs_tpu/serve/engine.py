@@ -978,7 +978,7 @@ class CcsEngine:
                      "ccs_retries_", "ccs_quarantine", "ccs_degraded_",
                      "ccs_watchdog_", "ccs_faults_", "ccs_sched_",
                      "ccs_slo_", "ccs_refine_", "ccs_flight_",
-                     "ccs_metrics_", "ccs_roofline_")):
+                     "ccs_metrics_", "ccs_roofline_", "ccs_tenant_")):
                 continue
             suffix = "{%s}" % ",".join(
                 f"{k}={v}" for k, v in labels) if labels else ""

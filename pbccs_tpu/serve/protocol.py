@@ -71,10 +71,23 @@ Server replies:
 
 Error codes: bad_request (unparseable/invalid message -- the session
 stays open unless the frame itself broke framing, e.g. oversized),
-overloaded (admission queue full OR the per-session in-flight cap:
-backpressure, retry later), closed (engine shutting down), internal
-(the request raised inside the engine; the SERVER stays up, only this
-request fails).
+overloaded (admission queue full OR the per-session in-flight cap OR a
+tenant's fair-queue bound OR SLO-burn shedding: backpressure, retry
+later -- shed/over-quota rejections additionally carry a
+`retry_after_ms` hint the client backoff honors), closed (engine
+shutting down), internal (the request raised inside the engine; the
+SERVER stays up, only this request fails), unauthorized (an
+authenticated front door -- `--authTokens` -- saw a frame whose `auth`
+bearer token is missing or unknown; the session stays open, nothing
+else in the frame was parsed).
+
+Multi-tenant edge (serve/tenancy.py): with a token file configured,
+every frame must carry `auth: "<token>"`; the token maps to a tenant
+(quota, priority class, DRR weight) and IS the identity.  A submit MAY
+carry a `tenant` object -- {"name": <tenant>} -- but it is honored only
+from a `trusted` token (the router forwarding the original submitter to
+a replica); from anyone else it is ignored, so tenants cannot spoof
+each other's accounting or quotas.
 
 Protocol armor (ServeConfig limits, enforced by server._Session): frames
 longer than max_line_bytes get `bad_request` and the session closes;
@@ -128,6 +141,7 @@ ERR_BAD_REQUEST = "bad_request"
 ERR_OVERLOADED = "overloaded"
 ERR_CLOSED = "closed"
 ERR_INTERNAL = "internal"
+ERR_UNAUTHORIZED = "unauthorized"
 
 # optional wire fields (cross-cutting objects that may ride a verb frame)
 FIELD_TRACE = "trace"
@@ -158,6 +172,26 @@ FIELD_SUPERVISOR = "supervisor"
 KEY_SUP_SLOTS = "slots"
 KEY_SUP_EVENTS = "events"
 KEY_SUP_ROLLING = "rolling_restart"
+
+# multi-tenant edge (serve/tenancy.py).  `auth` is the bearer token an
+# authenticated front door (--authTokens) requires on EVERY verb frame;
+# a frame without a known token gets ERR_UNAUTHORIZED.  `tenant` is the
+# identity object the router forwards on the replica hop -- the token,
+# not this field, is the identity at the edge (a non-trusted session's
+# tenant field is ignored; see tenancy.resolve_tenant).
+FIELD_AUTH = "auth"
+FIELD_TENANT = "tenant"
+KEY_TENANT_NAME = "name"
+# error replies answering a shed/over-quota submit carry a client
+# backoff hint in milliseconds (client.submit_with_retry honors it,
+# capped + jittered); rides reply frames, so it has no carrier verb.
+FIELD_RETRY_AFTER = "retry_after_ms"
+# status-verb tenancy block (tenancy.FairQueue.rows + shed state):
+# per-tenant admission accounting rendered by `ccs top`.
+FIELD_TENANCY = "tenancy"
+KEY_TEN_TENANTS = "tenants"
+KEY_TEN_BURN = "burn_rate"
+KEY_TEN_SHEDDING = "shedding"
 
 
 # ------------------------------------------------------------------ wire spec
@@ -202,7 +236,8 @@ WIRE_REPLIES = (TYPE_RESULT, TYPE_ERROR, TYPE_STATUS, TYPE_METRICS,
 # server->client types no verb elicits (drain / idle-reap notices)
 WIRE_UNSOLICITED = (TYPE_CLOSED,)
 
-WIRE_ERRORS = (ERR_BAD_REQUEST, ERR_OVERLOADED, ERR_CLOSED, ERR_INTERNAL)
+WIRE_ERRORS = (ERR_BAD_REQUEST, ERR_OVERLOADED, ERR_CLOSED, ERR_INTERNAL,
+               ERR_UNAUTHORIZED)
 
 # optional cross-cutting wire FIELDS: {field: {"keys": (...), "verbs":
 # (carrier verbs...)}}.  protolint's PRO001 checks the FIELD_*/KEY_*
@@ -232,6 +267,27 @@ WIRE_FIELDS = {
     FIELD_SUPERVISOR: {"keys": (KEY_SUP_SLOTS, KEY_SUP_EVENTS,
                                 KEY_SUP_ROLLING),
                        "verbs": (VERB_STATUS,)},
+    # may ride EVERY verb frame: the bearer token an authenticated front
+    # door (--authTokens) requires before dispatching the verb at all; a
+    # missing/unknown token answers ERR_UNAUTHORIZED and the frame is
+    # never parsed further.
+    FIELD_AUTH: {"keys": (),
+                 "verbs": (VERB_SUBMIT, VERB_STATUS, VERB_METRICS,
+                           VERB_TRACE, VERB_FLEET, VERB_PING)},
+    # rides the SUBMIT frame on the router->replica hop: the router
+    # (whose link token is `trusted`) forwards the ORIGINAL submitter's
+    # identity so replica-side accounting stays per-tenant.  From a
+    # non-trusted session the field is ignored (spoofing defense).
+    FIELD_TENANT: {"keys": (KEY_TENANT_NAME,),
+                   "verbs": (VERB_SUBMIT,)},
+    # rides error REPLIES (shed / over-quota): no carrier verb.
+    FIELD_RETRY_AFTER: {"keys": (), "verbs": ()},
+    # rides the STATUS exchange: present when the answering router runs
+    # with a token file -- per-tenant admission rows (FairQueue.rows),
+    # the fleet burn rate, and whether shedding is engaged.
+    FIELD_TENANCY: {"keys": (KEY_TEN_TENANTS, KEY_TEN_BURN,
+                             KEY_TEN_SHEDDING),
+                    "verbs": (VERB_STATUS,)},
 }
 
 
@@ -291,6 +347,27 @@ def trace_from_wire(obj: Any) -> dict[str, Any] | None:
             f"trace.{KEY_SPAN_ID} must be a string "
             f"(<= {_TRACE_VALUE_MAX} chars)")
     return {KEY_TRACE_ID: trace_id, KEY_SPAN_ID: span_id}
+
+
+# --------------------------------------------------------------- tenant wire
+
+def tenant_from_wire(obj: Any) -> dict[str, Any] | None:
+    """Validate + normalize a frame's optional `tenant` field (the
+    identity object a trusted router forwards on the replica hop).
+    Returns {"name": str}, or None when absent; raises ProtocolError
+    (-> bad_request) on malformed input -- the same armor contract as
+    trace_from_wire, and the same size bound."""
+    if obj is None:
+        return None
+    if not isinstance(obj, dict):
+        raise ProtocolError("tenant must be an object")
+    name = obj.get(KEY_TENANT_NAME)
+    if not isinstance(name, str) or not name \
+            or len(name) > _TRACE_VALUE_MAX:
+        raise ProtocolError(
+            f"tenant.{KEY_TENANT_NAME} must be a non-empty string "
+            f"(<= {_TRACE_VALUE_MAX} chars)")
+    return {KEY_TENANT_NAME: name}
 
 
 # ------------------------------------------------------------------ ZMW wire
@@ -387,6 +464,14 @@ def result_to_wire(request_id: Any, zmw_id: str, failure: Failure,
     return msg
 
 
-def error_to_wire(request_id: Any, code: str, message: str) -> dict[str, Any]:
-    return {"type": TYPE_ERROR, "id": request_id, "code": code,
-            "error": message}
+def error_to_wire(request_id: Any, code: str, message: str,
+                  retry_after_ms: float | None = None) -> dict[str, Any]:
+    """One structured error reply.  `retry_after_ms` (shed / over-quota
+    rejections) tells the client WHEN to retry -- submit_with_retry
+    honors it over its own exponential schedule, so a shedding fleet
+    paces its retry storm instead of amplifying it."""
+    msg = {"type": TYPE_ERROR, "id": request_id, "code": code,
+           "error": message}
+    if retry_after_ms is not None:
+        msg[FIELD_RETRY_AFTER] = round(float(retry_after_ms), 3)
+    return msg

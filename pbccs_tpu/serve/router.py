@@ -45,11 +45,23 @@ The router front door reuses the serve server's framed-session armor
 in-flight cap, and abort accounting all behave identically at both
 tiers (tools/fuzz_inputs.py points the same wire legs at each).
 
+The multi-tenant edge (serve/tenancy.py) layers in FRONT of routing:
+with ``--authTokens`` every session authenticates (token -> tenant),
+admission is weighted-fair across tenants (per-tenant in-flight quotas,
+bounded park queues, deficit-round-robin release), and when the fleet's
+windowed SLO burn rate (from the same health probes) crosses
+``--shedBurnRate`` the router sheds priority >= 1 work with a
+``retry_after_ms`` hint before it can queue.  ``--tlsCert/--tlsKey``
+secure the front door and the metrics endpoint; ``--tlsCa`` +
+``--authToken`` secure and authenticate the replica links.
+
 Metrics: ``ccs_router_routed_total{replica}``,
 ``ccs_router_failovers_total{replica}``,
 ``ccs_router_health_checks_total{replica,outcome}``,
 ``ccs_router_replica_unhealthy_total{replica}``,
-``ccs_router_inflight{replica}``, ``ccs_router_dedup_dropped_total``.
+``ccs_router_inflight{replica}``, ``ccs_router_dedup_dropped_total``,
+``ccs_router_fleet_burn_rate`` (tenant-plane ``ccs_tenant_*`` metrics
+live in serve/tenancy.py).
 """
 
 from __future__ import annotations
@@ -72,7 +84,7 @@ from pbccs_tpu.obs.metrics import (
 )
 from pbccs_tpu.runtime.logging import Logger, LogLevel
 from pbccs_tpu.sched.health import HealthPolicy, HealthTracker, StickyMap
-from pbccs_tpu.serve import protocol
+from pbccs_tpu.serve import protocol, tenancy
 from pbccs_tpu.serve.server import CcsServer, _FramedSession
 
 _reg = default_registry()
@@ -127,6 +139,22 @@ class RouterConfig:
     # status reply) -- the fleet-wide ledger merge.  None disables.
     perf_ledger_path: str | None = None
     perf_ledger_interval_s: float = 30.0
+    # ---- multi-tenant edge (serve/tenancy.py) ----
+    # weighted-fair admission engages when the router front door runs a
+    # token file AND fair_queue is on: per-tenant in-flight quotas (from
+    # the token file), a bounded per-tenant park queue, DRR drain.  Off
+    # (or with no token file) admission is the legacy direct dispatch.
+    fair_queue: bool = True
+    fair_queue_depth: int = 64     # parked submits per tenant, max
+    drr_quantum: int = 4           # DRR credit per round (x tenant weight)
+    # SLO-driven shedding: when the fleet burn rate (violations /
+    # requests over shed_window_s, from health-probe slo blocks) crosses
+    # the threshold, submits from priority >= 1 tenants are rejected
+    # `overloaded` with a retry_after_ms hint; priority 0 is NEVER shed.
+    # 0 disables shedding.
+    shed_burn_threshold: float = 0.0
+    shed_window_s: float = 30.0
+    retry_after_ms: float = 1000.0  # backoff hint on shed/quota rejects
 
     def __post_init__(self):
         if self.bench_after < 1:
@@ -148,6 +176,17 @@ class RouterConfig:
         if self.reconnect_backoff_cap_s < self.reconnect_backoff_base_s:
             raise ValueError("reconnect_backoff_cap_s must be >= "
                              "reconnect_backoff_base_s")
+        if self.fair_queue_depth < 1:
+            raise ValueError("fair_queue_depth must be >= 1")
+        if self.drr_quantum < 1:
+            raise ValueError("drr_quantum must be >= 1")
+        if not 0.0 <= self.shed_burn_threshold <= 1.0:
+            raise ValueError("shed_burn_threshold must be in [0, 1] "
+                             "(a violation fraction; 0 disables)")
+        if self.shed_window_s <= 0:
+            raise ValueError("shed_window_s must be > 0")
+        if self.retry_after_ms < 0:
+            raise ValueError("retry_after_ms must be >= 0")
 
 
 def parse_replica_spec(spec) -> tuple[str, int]:
@@ -183,11 +222,12 @@ class RoutedRequest:
     once (guarded by the router lock via `done`)."""
 
     __slots__ = ("rid", "key", "wire", "deadline_ms", "emit", "attempted",
-                 "assigned", "done", "submit_t", "trace")
+                 "assigned", "done", "submit_t", "trace", "tenant")
 
     def __init__(self, rid: str, key, wire: dict, deadline_ms,
                  emit: Callable[[dict], None],
-                 trace: dict | None = None):
+                 trace: dict | None = None,
+                 tenant: str | None = None):
         self.rid = rid
         self.key = key
         self.wire = wire
@@ -202,6 +242,10 @@ class RoutedRequest:
         # rewritten to this request's router span (`rt-<rid>`), exactly
         # as the request id itself is rewritten
         self.trace = trace
+        # resolved tenant identity (token-derived at the edge session);
+        # forwarded to replicas in the wire `tenant` field and the key
+        # the fair queue charges admission against
+        self.tenant = tenant
 
     def span_id(self) -> str:
         """The router-side span id the replica hop parents under."""
@@ -234,6 +278,14 @@ class ReplicaLink:
     def send(self, msg: dict) -> bool:
         """Best-effort frame to the replica; False marks the link dead
         (the caller runs the failover sweep, never this thread)."""
+        token = self._router._link_token
+        if token is not None and protocol.FIELD_AUTH not in msg:
+            # authenticated replica hop: EVERY router-originated frame
+            # (submits, health probes, fleet calls) carries the link
+            # token, so a token-guarded replica never strikes its own
+            # router's probes as unauthorized
+            msg = dict(msg)
+            msg[protocol.FIELD_AUTH] = token
         data = protocol.encode_msg(msg)
         try:
             with self._wlock:
@@ -346,10 +398,36 @@ class CcsRouter:
     submit_routed()."""
 
     def __init__(self, replicas, config: RouterConfig | None = None, *,
-                 logger: Logger | None = None):
-        """`replicas`: "host:port" strings or (host, port) pairs."""
+                 logger: Logger | None = None,
+                 tenants: tenancy.TenantDirectory | None = None,
+                 link_ssl=None, link_token: str | None = None):
+        """`replicas`: "host:port" strings or (host, port) pairs.
+
+        `tenants` (the edge token directory) turns on weighted-fair
+        admission and SLO-burn shedding; `link_ssl` (an ssl.SSLContext)
+        wraps every replica connection; `link_token` rides every
+        router-originated frame so token-guarded replicas accept the
+        router's submits and probes."""
         self.config = config or RouterConfig()
         self._log = logger or Logger.default()
+        self._tenants = tenants
+        self._link_ssl = link_ssl
+        self._link_token = link_token
+        self._fair = (tenancy.FairQueue(
+            tenants, queue_depth=self.config.fair_queue_depth,
+            quantum=self.config.drr_quantum)
+            if tenants is not None and self.config.fair_queue else None)
+        self._burn = tenancy.BurnMeter(self.config.shed_window_s)
+        self._shed_total = 0
+        # non-reentrant fair-queue pump: the holder of _pump_lock drains
+        # until _pump_flag stays clear (a dispatch failing inline frees
+        # slots and re-raises the flag; the holder's loop picks it up)
+        self._pump_lock = threading.Lock()
+        self._pump_flag = threading.Event()
+        self._m_burn = _reg.gauge(
+            "ccs_router_fleet_burn_rate",
+            "Windowed fleet SLO burn rate (violations/requests) from "
+            "replica health probes; the shed policy thresholds on it")
         parsed = [parse_replica_spec(spec) for spec in replicas]
         if not parsed and not self.config.allow_empty:
             raise ValueError("CcsRouter needs at least one replica")
@@ -516,6 +594,15 @@ class CcsRouter:
         for req in leftovers:
             self._emit(req, protocol.error_to_wire(
                 None, protocol.ERR_CLOSED, "router is shutting down"))
+        # fair-queue stragglers (parked, never dispatched -- not in
+        # _requests): fail them with the same structured closed error
+        if self._fair is not None:
+            for _tenant, req in self._fair.flush():
+                if not req.done:
+                    req.done = True
+                    self._emit(req, protocol.error_to_wire(
+                        None, protocol.ERR_CLOSED,
+                        "router is shutting down"))
         for link in links:
             link.close()
         with self._lock:
@@ -650,21 +737,80 @@ class CcsRouter:
 
     def submit_routed(self, wire_zmw: dict, key, deadline_ms,
                       emit: Callable[[dict], None],
-                      trace: dict | None = None) -> RoutedRequest:
+                      trace: dict | None = None,
+                      tenant: str | None = None) -> RoutedRequest:
         """Route one validated wire-shaped ZMW; `emit` receives exactly
         one reply dict (result or structured error; the caller rewrites
         the id).  `trace` is the request's validated trace context
         (client-sent, or edge-minted by the session when a capture is
-        live).  Raises RouterClosed after close()."""
+        live); `tenant` the session's resolved identity.  With a token
+        directory configured the request passes the shed gate (SLO burn
+        x priority class) and the fair queue before routing.  Raises
+        RouterClosed after close()."""
         with self._lock:
             if not self._accepting:
                 raise RouterClosed("router is not accepting requests")
             self._seq += 1
             rid = f"q{self._seq}"
         req = RoutedRequest(rid, key, wire_zmw, deadline_ms, emit,
-                            trace=trace)
-        self._dispatch(req)
+                            trace=trace, tenant=tenant)
+        fair = self._fair
+        if fair is None or tenant is None:
+            self._dispatch(req)
+            return req
+        tenancy.count_request(tenant)
+        cfg = self.config
+        row = self._tenants.get(tenant)
+        # shed gate first: under SLO burn, best-effort classes are
+        # rejected BEFORE they can occupy queue slots (priority 0 is
+        # never shed -- it rides straight into fair admission)
+        burn = self._burn.rate() if cfg.shed_burn_threshold > 0 else 0.0
+        if (cfg.shed_burn_threshold > 0 and row is not None
+                and row.priority >= 1
+                and burn >= cfg.shed_burn_threshold):
+            fair.record_shed(tenant)
+            with self._lock:
+                self._shed_total += 1
+            req.done = True
+            self._emit(req, protocol.error_to_wire(
+                None, protocol.ERR_OVERLOADED,
+                f"shedding priority-{row.priority} work: fleet SLO burn "
+                f"{burn:.3f} >= {cfg.shed_burn_threshold:g}; retry later",
+                retry_after_ms=cfg.retry_after_ms))
+            return req
+        verdict = fair.try_admit(tenant, req)
+        if verdict == "dispatch":
+            self._dispatch(req)
+        elif verdict == "rejected":
+            req.done = True
+            self._emit(req, protocol.error_to_wire(
+                None, protocol.ERR_OVERLOADED,
+                f"tenant {tenant!r} over quota with a full fair queue "
+                f"({cfg.fair_queue_depth} parked); retry later",
+                retry_after_ms=cfg.retry_after_ms))
+        # "queued": parked under the tenant's bound; a freed slot
+        # releases it through _pump_fair in DRR order
         return req
+
+    def _pump_fair(self) -> None:
+        """Dispatch whatever the fair queue releases.  Non-reentrant:
+        a dispatch that fails inline completes requests -> frees slots
+        -> lands here again; the inner call just raises the flag and the
+        active pumper's loop re-drains.  Never called under the router
+        lock (dispatch sends block)."""
+        fair = self._fair
+        if fair is None:
+            return
+        self._pump_flag.set()
+        while self._pump_flag.is_set():
+            if not self._pump_lock.acquire(blocking=False):
+                return  # the active pumper will observe the flag
+            try:
+                self._pump_flag.clear()
+                for _tenant, req in fair.drain():
+                    self._dispatch(req)
+            finally:
+                self._pump_lock.release()
 
     def _routable_locked(self, replica: _Replica) -> bool:
         return (replica.link is not None and replica.link.alive
@@ -742,6 +888,12 @@ class CcsRouter:
                     protocol.KEY_TRACE_ID:
                         req.trace[protocol.KEY_TRACE_ID],
                     protocol.KEY_SPAN_ID: req.span_id()}
+            if req.tenant is not None:
+                # forward the ORIGINAL submitter's identity; the replica
+                # honors it because the link token's tenant is trusted
+                # (tenancy.resolve_tenant's one exception)
+                msg[protocol.FIELD_TENANT] = {
+                    protocol.KEY_TENANT_NAME: req.tenant}
             if link.send(msg):
                 return
             # the link died under us.  If the request is still parked on
@@ -795,6 +947,7 @@ class CcsRouter:
             q = self._emit_queue
         if q is not None:
             q.put((req, msg))
+            self._pump_fair()   # a completion may have freed a slot
             return
         # router already torn down (or never started): emit inline,
         # best-effort -- there is no reader thread left to protect
@@ -803,6 +956,7 @@ class CcsRouter:
         except Exception as e:  # noqa: BLE001 -- a dead client must not
             # leak out of the teardown path
             self._log.debug(f"router reply emit failed: {e!r}")
+        self._pump_fair()
 
     def _emit_worker(self, q: queue.Queue) -> None:
         while True:
@@ -827,6 +981,12 @@ class CcsRouter:
             if owner is not None \
                     and owner.inflight.pop(req.rid, None) is not None:
                 owner.m_inflight.set(owner.depth())
+        if req.tenant is not None and self._fair is not None:
+            # free the tenant's admission slot (FairQueue has its own
+            # lock and never calls back -- safe under the router lock);
+            # the emit that follows this completion runs _pump_fair, so
+            # the freed slot releases parked work promptly
+            self._fair.complete(req.tenant)
         self._completed_total += 1
 
     # ----------------------------------------------------------- replica IO
@@ -969,6 +1129,19 @@ class CcsRouter:
                 timeout=self.config.connect_timeout_s)
         except OSError:
             return False  # stays down; the next due tick retries
+        if self._link_ssl is not None:
+            # TLS replica hop: handshake under the same connect bound; a
+            # failed handshake (plaintext replica, cert the CA rejects)
+            # is a failed connect -- backoff doubles, no traceback
+            try:
+                sock = self._link_ssl.wrap_socket(
+                    sock, server_hostname=replica.host)
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return False
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
@@ -1080,6 +1253,32 @@ class CcsRouter:
             self._fail_link(replica, link, "health probe send failed")
 
     def _on_probe_reply(self, replica: _Replica, msg: dict) -> None:
+        if msg.get("type") == protocol.TYPE_ERROR:
+            # a replica ANSWERING probes with structured errors (e.g.
+            # rejecting the router's link token as unauthorized) is not
+            # healthy: strike it like a timeout, with the reason logged
+            # -- a token misconfiguration must surface, not read as ok
+            moved: list[RoutedRequest] = []
+            with self._lock:
+                if msg.get("id") != replica.probe_id:
+                    return
+                replica.probe_id = None
+                benched = self._health.record_failure(replica.name)
+                if benched:
+                    replica.m_unhealthy.inc()
+                    self._sticky.forget_member(replica.name)
+                    moved = self._sweep_inflight_locked(replica)
+            replica.m_hc_fail.inc()
+            self._log.warn(
+                f"router: replica {replica.name} rejected a health probe "
+                f"({msg.get('code')}: {msg.get('message')})")
+            for req in moved:
+                self._dispatch(req)
+            return
+        # SLO burn signal: every probe reply's `slo` block (lifetime
+        # requests/violations) feeds the shed policy's windowed meter
+        self._burn.observe(replica.name, msg.get("slo"))
+        self._m_burn.set(round(self._burn.rate(), 6))
         accepting = bool(msg.get("accepting", True))
         try:
             pending = max(0, int(msg.get("pending", 0)))
@@ -1236,6 +1435,20 @@ class CcsRouter:
                 if isinstance(v, (int, float)):
                     rec[field] = v
             ledger.append(rec)
+        if self._fair is not None:
+            # one tenant_snapshot per tenant per tick: the per-tenant
+            # ledger plane analyze/perf tooling reads
+            for row in self._fair.rows():
+                ledger.append({
+                    "kind": "tenant_snapshot", "source": "ccs-router",
+                    "tenant": row["name"],
+                    "tenant_priority": row["priority"],
+                    "tenant_inflight": row["inflight"],
+                    "tenant_queued": row["queued"],
+                    "tenant_completed": row["completed"],
+                    "tenant_sheds": row["shed"],
+                    "tenant_rejects": row["rejected"],
+                })
 
     # ------------------------------------------- status / metrics (session)
 
@@ -1270,6 +1483,7 @@ class CcsRouter:
                 "completed": self._completed_total,
                 "failovers": self._failover_total,
                 "deduped": self._dedup_total,
+                "shed": self._shed_total,
                 "replicas": replicas,
             }
         if supervisor is not None:
@@ -1278,6 +1492,17 @@ class CcsRouter:
             # while holding their own -- nesting the other way here
             # would be a lock-order inversion
             out[protocol.FIELD_SUPERVISOR] = supervisor.status_block()
+        if self._fair is not None:
+            # per-tenant accounting (FairQueue's own lock; outside the
+            # router lock): `ccs top` renders this block verbatim
+            burn = self._burn.rate()
+            out[protocol.FIELD_TENANCY] = {
+                protocol.KEY_TEN_TENANTS: self._fair.rows(),
+                protocol.KEY_TEN_BURN: round(burn, 6),
+                protocol.KEY_TEN_SHEDDING: bool(
+                    self.config.shed_burn_threshold > 0
+                    and burn >= self.config.shed_burn_threshold),
+            }
         return out
 
     def metrics_text(self) -> str:
@@ -1310,7 +1535,19 @@ class _RouterSession(_FramedSession):
         if parsed is None:
             self._release_slot()
             return
-        chunk, deadline_ms, trace_ctx = parsed
+        chunk, deadline_ms, trace_ctx, tenant = parsed
+        directory = self.server.tenants
+        if directory is not None and tenant is not None \
+                and directory.get(tenant) is None:
+            # a trusted peer forwarded an identity the token file does
+            # not know: refuse rather than route unaccounted work (the
+            # fair queue has no state for it)
+            self._release_slot()
+            tenancy.count_auth_failure("unknown_tenant")
+            self.send(protocol.error_to_wire(
+                rid, protocol.ERR_UNAUTHORIZED,
+                f"unknown tenant {tenant!r}"))
+            return
         if trace_ctx is None and obs_trace.get_tracer() is not None:
             # edge-minted trace id: with a capture live, every request
             # gets a fleet-wide identity even when the client sent none
@@ -1329,7 +1566,7 @@ class _RouterSession(_FramedSession):
             # validation accepted
             self.server.engine.submit_routed(
                 protocol.chunk_to_wire(chunk), route_key(chunk),
-                deadline_ms, on_reply, trace=trace_ctx)
+                deadline_ms, on_reply, trace=trace_ctx, tenant=tenant)
         except RouterClosed as e:
             self._release_slot()
             self.send(protocol.error_to_wire(rid, protocol.ERR_CLOSED,
@@ -1529,6 +1766,50 @@ def build_router_parser() -> argparse.ArgumentParser:
                    default=defaults.perf_ledger_interval_s,
                    help="Seconds between fleet ledger ticks. "
                         "Default = %(default)s")
+    # ---- multi-tenant edge (serve/tenancy.py) ----
+    p.add_argument("--tlsCert", default=None, metavar="PEM",
+                   help="TLS certificate chain for the front door AND "
+                        "the metrics endpoint (with --tlsKey). "
+                        "Default: plaintext.")
+    p.add_argument("--tlsKey", default=None, metavar="PEM",
+                   help="TLS private key (with --tlsCert).")
+    p.add_argument("--authTokens", default=None, metavar="FILE",
+                   help="JSON token->tenant map; turns on edge token "
+                        "auth, per-tenant fair queuing, and SLO-burn "
+                        "shedding. Default: open front door.")
+    p.add_argument("--tlsCa", default=None, metavar="PEM",
+                   help="CA bundle to verify REPLICA certificates; also "
+                        "switches replica links to TLS. Default: "
+                        "plaintext links.")
+    p.add_argument("--tlsReplicas", action="store_true",
+                   help="Wrap replica links in TLS without CA pinning "
+                        "(encrypted, unauthenticated; prefer --tlsCa).")
+    p.add_argument("--authToken", default=None, metavar="TOKEN",
+                   help="Bearer token the router presents on every "
+                        "replica-link frame (submits, health probes, "
+                        "fleet calls) to token-guarded replicas.")
+    p.add_argument("--shedBurnRate", type=float,
+                   default=defaults.shed_burn_threshold,
+                   help="Fleet SLO burn rate (violating fraction over "
+                        "--shedWindow) past which priority >= 1 tenants "
+                        "are shed with a retry hint; 0 disables. "
+                        "Default = %(default)s")
+    p.add_argument("--shedWindow", type=float,
+                   default=defaults.shed_window_s,
+                   help="Burn-rate sliding window, seconds. "
+                        "Default = %(default)s")
+    p.add_argument("--shedRetryMs", type=float,
+                   default=defaults.retry_after_ms,
+                   help="retry_after_ms hint on shed/quota rejections. "
+                        "Default = %(default)s")
+    p.add_argument("--tenantQueueDepth", type=int,
+                   default=defaults.fair_queue_depth,
+                   help="Parked submits per tenant before rejection. "
+                        "Default = %(default)s")
+    p.add_argument("--noFairQueue", action="store_true",
+                   help="Disable weighted-fair admission even with "
+                        "--authTokens (auth only; legacy direct "
+                        "dispatch).")
     p.add_argument("--logLevel", default="INFO")
     return p
 
@@ -1537,6 +1818,14 @@ def run_router(argv: list[str] | None = None) -> int:
     """`ccs router` entry point (dispatched from pbccs_tpu.cli)."""
     args = build_router_parser().parse_args(argv)
     log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+    from pbccs_tpu.serve.server import load_edge_config
+
+    edge = load_edge_config(args, "ccs router")
+    if edge is None:
+        return 2
+    ssl_ctx, tenants = edge
+    link_ssl = (tenancy.client_ssl_context(args.tlsCa)
+                if args.tlsCa or args.tlsReplicas else None)
     try:
         config = RouterConfig(
             health_interval_s=args.routerHealthInterval,
@@ -1548,21 +1837,29 @@ def run_router(argv: list[str] | None = None) -> int:
             max_inflight_per_session=args.maxInflightPerSession,
             idle_timeout_s=args.idleTimeout,
             perf_ledger_path=args.perfLedger,
-            perf_ledger_interval_s=args.perfLedgerInterval)
-        router = CcsRouter(args.replica, config, logger=log)
+            perf_ledger_interval_s=args.perfLedgerInterval,
+            fair_queue=not args.noFairQueue,
+            fair_queue_depth=args.tenantQueueDepth,
+            shed_burn_threshold=args.shedBurnRate,
+            shed_window_s=args.shedWindow,
+            retry_after_ms=args.shedRetryMs)
+        router = CcsRouter(args.replica, config, logger=log,
+                           tenants=tenants, link_ssl=link_ssl,
+                           link_token=args.authToken)
     except ValueError as e:
         # a knob or replica spec the dataclass/router rejected: a clean
         # usage error, not a traceback (the message names the field)
         print(f"ccs router: {e}", file=sys.stderr)
         return 2
     with router:
-        server = RouterServer(router, args.host, args.port, logger=log)
+        server = RouterServer(router, args.host, args.port, logger=log,
+                              ssl_context=ssl_ctx, tenants=tenants)
         server.start()
         from pbccs_tpu.serve.server import start_metrics_endpoint
 
         metrics_http = start_metrics_endpoint(
             args.metricsPort, router.metrics_text, args.host, log,
-            health=router.accepting)
+            health=router.accepting, ssl_context=ssl_ctx)
         # machine-readable ready line for wrappers (mirrors CCS-SERVE-READY)
         print(f"CCS-ROUTER-READY {server.host} {server.port}", flush=True)
 

@@ -29,12 +29,13 @@ import argparse
 import os
 import signal
 import socket
+import ssl
 import sys
 import threading
 
 from pbccs_tpu.obs.metrics import default_registry
 from pbccs_tpu.runtime.logging import Logger, LogLevel
-from pbccs_tpu.serve import protocol
+from pbccs_tpu.serve import protocol, tenancy
 from pbccs_tpu.serve.engine import (
     CcsEngine,
     EngineClosed,
@@ -85,6 +86,10 @@ class _FramedSession:
         self._slock = threading.Lock()
         self._ilock = threading.Lock()
         self._inflight = 0
+        # resolved ONCE per session from the first authenticated frame's
+        # bearer token (tenancy.TenantDirectory); None on an open front
+        # door.  Written only by the reader thread (_authenticate).
+        self.tenant: tenancy.Tenant | None = None
 
     def inflight(self) -> int:
         with self._ilock:
@@ -157,14 +162,18 @@ class _FramedSession:
 
     def _parse_submit(self, msg: dict):
         """Shared submit decode: validated (chunk, deadline, trace
-        context), or None after a structured `bad_request` reply (the
-        caller already released its slot-acquire responsibilities via
-        the returned sentinel)."""
+        context, effective tenant name), or None after a structured
+        `bad_request` reply (the caller already released its
+        slot-acquire responsibilities via the returned sentinel).  The
+        tenant is the AUTHENTICATED identity (tenancy.resolve_tenant):
+        the wire `tenant` field only matters from a trusted token."""
         rid = msg.get("id")
         try:
             chunk = protocol.chunk_from_wire(msg.get("zmw"))
             trace_ctx = protocol.trace_from_wire(
                 msg.get(protocol.FIELD_TRACE))
+            wire_tenant = protocol.tenant_from_wire(
+                msg.get(protocol.FIELD_TENANT))
         except protocol.ProtocolError as e:
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, str(e)))
@@ -175,7 +184,8 @@ class _FramedSession:
             self.send(protocol.error_to_wire(
                 rid, protocol.ERR_BAD_REQUEST, "deadline_ms must be a number"))
             return None
-        return chunk, deadline_ms, trace_ctx
+        tenant = tenancy.resolve_tenant(self.tenant, wire_tenant)
+        return chunk, deadline_ms, trace_ctx, tenant
 
     def _on_status(self, msg: dict) -> None:
         status = self.server.engine.status()
@@ -191,12 +201,41 @@ class _FramedSession:
 
     # ------------------------------------------------------------- reader
 
+    def _authenticate(self, msg: dict) -> bool:
+        """Token auth gate, ahead of verb dispatch: on an authenticated
+        front door (--authTokens) every frame must carry a known `auth`
+        bearer token.  Failure answers a structured ERR_UNAUTHORIZED --
+        the session survives, exactly like bad_request, but the frame is
+        never parsed further (no verb, no payload).  The resolved tenant
+        is cached on the session; per-frame tokens are still checked so
+        an interleaved bad frame cannot ride an earlier good one."""
+        directory = self.server.tenants
+        if directory is None:
+            return True
+        token = msg.get(protocol.FIELD_AUTH)
+        if token is None:
+            reason = "missing_token"
+        else:
+            tenant = directory.authenticate(token)
+            if tenant is not None:
+                self.tenant = tenant
+                return True
+            reason = "bad_token"
+        tenancy.count_auth_failure(reason)
+        self.send(protocol.error_to_wire(
+            msg.get("id"), protocol.ERR_UNAUTHORIZED,
+            f"auth failed ({reason}): this front door requires a known "
+            f"`{protocol.FIELD_AUTH}` bearer token on every frame"))
+        return False
+
     def _dispatch(self, line: bytes) -> None:
         try:
             msg = protocol.decode_line(line)
         except protocol.ProtocolError as e:
             self.send(protocol.error_to_wire(
                 None, protocol.ERR_BAD_REQUEST, str(e)))
+            return
+        if not self._authenticate(msg):
             return
         verb = msg.get("verb")
         if verb == protocol.VERB_SUBMIT:
@@ -290,7 +329,12 @@ class _Session(_FramedSession):
         if parsed is None:
             self._release_slot()
             return
-        chunk, deadline_ms, trace_ctx = parsed
+        chunk, deadline_ms, trace_ctx, tenant = parsed
+        if tenant is not None:
+            # replica-side per-tenant accounting: the router forwards the
+            # original submitter on the hop, so the federated exposition
+            # shows each tenant's load per replica
+            tenancy.count_request(tenant)
 
         def on_done(req: Request) -> None:
             self._release_slot()
@@ -347,10 +391,18 @@ class CcsServer:
     session_class: type = _Session
     name = "ccs serve"
 
+    # a stalled TLS handshake occupies ITS bring-up thread this long at
+    # most; the accept loop is never behind it
+    handshake_timeout_s = 10.0
+
     def __init__(self, engine: CcsEngine, host: str = "127.0.0.1",
-                 port: int = 0, logger: Logger | None = None):
+                 port: int = 0, logger: Logger | None = None,
+                 ssl_context: ssl.SSLContext | None = None,
+                 tenants: "tenancy.TenantDirectory | None" = None):
         self.engine = engine
         self.log = logger or Logger.default()
+        self.ssl_context = ssl_context
+        self.tenants = tenants
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -380,17 +432,49 @@ class CcsServer:
                 continue
             except OSError:
                 return  # listening socket closed
-            conn.settimeout(None)  # sessions block; accept timeout is ours
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # keepalive reaps sessions whose peer vanished without FIN
             # (power loss, NAT timeout): without it the reader thread and
             # fd of every half-open session leak for the server's lifetime
             conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-            session = self.session_class(self, conn, peer)
-            with self._slock:
-                self._sessions.add(session)
-            threading.Thread(target=session.run, daemon=True,
+            # per-connection bring-up happens OFF this loop: with TLS on,
+            # the handshake blocks, and one stalled/hostile handshake
+            # must never stop the fleet accepting (slow-loris armor)
+            threading.Thread(target=self._run_session, args=(conn, peer),
+                             daemon=True,
                              name=f"ccs-serve-session-{peer}").start()
+
+    def _run_session(self, conn: socket.socket, peer) -> None:
+        """Bring one accepted connection up (TLS handshake when
+        configured) and run its session.  A failed handshake is a
+        counted structured abort (ccs_serve_session_aborts_total
+        {cause="tls_handshake"}) -- a plaintext client probing a TLS'd
+        port, a bad cert, or a stalled handshake never tracebacks and
+        never reaches the framing layer."""
+        if self.ssl_context is not None:
+            conn.settimeout(self.handshake_timeout_s)
+            try:
+                conn = self.ssl_context.wrap_socket(conn, server_side=True)
+            except (OSError, ssl.SSLError) as e:
+                _count_abort("tls_handshake")
+                self.log.debug(
+                    f"session {peer}: TLS handshake failed ({e!r})")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+        conn.settimeout(None)  # sessions block; the reader sets idle reap
+        session = self.session_class(self, conn, peer)
+        with self._slock:
+            if self._shutdown.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._sessions.add(session)
+        session.run()
 
     def start(self) -> "CcsServer":
         """Start accepting in the background; returns immediately."""
@@ -523,6 +607,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="On SIGTERM/SIGINT, wait this long for in-flight "
                         "requests before fast-aborting the rest. "
                         "Default = %(default)s")
+    # multi-tenant edge (serve/tenancy.py, docs/DESIGN.md "Multi-tenant
+    # edge"): TLS on the front door + the metrics scrape, and a
+    # token->tenant map that turns on per-frame bearer-token auth
+    p.add_argument("--tlsCert", default=None, metavar="PEM",
+                   help="Serve the NDJSON front door (and --metricsPort) "
+                        "over TLS with this certificate chain; requires "
+                        "--tlsKey.  Default: plaintext.")
+    p.add_argument("--tlsKey", default=None, metavar="PEM",
+                   help="Private key for --tlsCert.")
+    p.add_argument("--authTokens", default=None, metavar="FILE",
+                   help="JSON token->tenant map (tenancy.TenantDirectory): "
+                        "when set, every frame must carry a known `auth` "
+                        "bearer token or gets a structured `unauthorized`. "
+                        "Default: open front door.")
     # observability plane (obs/): the HTTP scrape surface + SLO target
     p.add_argument("--metricsPort", type=int, default=0,
                    help="Serve a stdlib-HTTP Prometheus /metrics scrape "
@@ -570,6 +668,10 @@ def run_serve(argv: list[str] | None = None) -> int:
         print(f"option --devices: must be >= 0, got {args.devices}",
               file=sys.stderr)
         return 2
+    edge = load_edge_config(args, "ccs serve")
+    if edge is None:
+        return 2
+    ssl_ctx, tenants = edge
 
     from pbccs_tpu.resilience import faults
 
@@ -609,11 +711,12 @@ def run_serve(argv: list[str] | None = None) -> int:
         perf_ledger_interval_s=args.perfLedgerInterval)
 
     with CcsEngine(settings, config, logger=log) as engine:
-        server = CcsServer(engine, args.host, args.port, logger=log)
+        server = CcsServer(engine, args.host, args.port, logger=log,
+                           ssl_context=ssl_ctx, tenants=tenants)
         server.start()
         metrics_http = start_metrics_endpoint(
             args.metricsPort, engine.metrics_text, args.host, log,
-            health=engine.accepting)
+            health=engine.accepting, ssl_context=ssl_ctx)
         # machine-readable ready line for wrappers (serve_bench polls it)
         print(f"CCS-SERVE-READY {server.host} {server.port}", flush=True)
 
@@ -651,22 +754,52 @@ def run_serve(argv: list[str] | None = None) -> int:
     return 0
 
 
+def load_edge_config(args, prog: str):
+    """Shared `--tlsCert/--tlsKey/--authTokens` resolution for `ccs
+    serve` / `ccs router` / `ccs fleet`: returns (ssl_context | None,
+    TenantDirectory | None), or None after printing a structured usage
+    error (the caller exits 2).  Bad PEMs and malformed token files are
+    startup errors, never a half-secured listener."""
+    if bool(args.tlsCert) != bool(args.tlsKey):
+        print(f"{prog}: --tlsCert and --tlsKey must be given together",
+              file=sys.stderr)
+        return None
+    ssl_ctx = None
+    if args.tlsCert:
+        try:
+            ssl_ctx = tenancy.server_ssl_context(args.tlsCert, args.tlsKey)
+        except (OSError, ssl.SSLError) as e:
+            print(f"{prog}: cannot load TLS cert/key: {e}", file=sys.stderr)
+            return None
+    tenants = None
+    if args.authTokens:
+        try:
+            tenants = tenancy.TenantDirectory.from_file(args.authTokens)
+        except (OSError, ValueError) as e:
+            print(f"{prog}: --authTokens: {e}", file=sys.stderr)
+            return None
+    return ssl_ctx, tenants
+
+
 def start_metrics_endpoint(port: int, render, host: str, log,
-                           health=None):
+                           health=None, ssl_context=None):
     """Shared `--metricsPort` wiring for `ccs serve` and `ccs router`:
     0 disables, -1 binds an ephemeral port; the bound port is printed as
     a machine-readable CCS-METRICS-READY line (wrappers/smokes poll it,
     mirroring CCS-SERVE-READY).  `health` backs /healthz (engine/router
     `accepting`), so a draining process probes 503 before its socket
-    ever closes."""
+    ever closes.  `ssl_context` (the front door's --tlsCert context)
+    makes the scrape endpoint HTTPS -- a TLS'd fleet has NO plaintext
+    surface, including metrics."""
     if port == 0:
         return None
     from pbccs_tpu.obs.httpexp import start_metrics_http
 
     server = start_metrics_http(render, host=host,
                                 port=0 if port < 0 else port,
-                                health=health)
+                                health=health, ssl_context=ssl_context)
     print(f"CCS-METRICS-READY {host} {server.server_port}", flush=True)
+    scheme = "https" if ssl_context is not None else "http"
     log.info(f"metrics scrape endpoint on "
-             f"http://{host}:{server.server_port}/metrics")
+             f"{scheme}://{host}:{server.server_port}/metrics")
     return server

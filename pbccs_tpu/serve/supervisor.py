@@ -861,8 +861,60 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    default=rdefaults.perf_ledger_interval_s,
                    help="Router fleet-snapshot cadence. "
                         "Default = %(default)s")
+    # ----- multi-tenant edge (serve/tenancy.py): one flag set secures
+    # every surface -- router front door, metrics endpoint, spawned
+    # replicas, router->replica links, and the admin client
+    p.add_argument("--tlsCert", default=None, metavar="PEM",
+                   help="TLS certificate chain for the router front "
+                        "door, the metrics endpoint AND every spawned "
+                        "replica (with --tlsKey). Default: plaintext.")
+    p.add_argument("--tlsKey", default=None, metavar="PEM",
+                   help="TLS private key (with --tlsCert).")
+    p.add_argument("--authTokens", default=None, metavar="FILE",
+                   help="JSON token->tenant map applied at the router "
+                        "edge AND passed to every replica; enables "
+                        "per-tenant fair queuing + SLO shedding. "
+                        "Default: open.")
+    p.add_argument("--tlsCa", default=None, metavar="PEM",
+                   help="CA bundle verifying replica/router certs for "
+                        "the router links and admin actions; also "
+                        "switches those connections to TLS.")
+    p.add_argument("--authToken", default=None, metavar="TOKEN",
+                   help="Bearer token for the router's replica links "
+                        "(map it to a trusted tenant in --authTokens) "
+                        "and for admin actions against --target.")
+    p.add_argument("--shedBurnRate", type=float,
+                   default=rdefaults.shed_burn_threshold,
+                   help="Fleet SLO burn rate past which priority >= 1 "
+                        "tenants are shed (0 disables). "
+                        "Default = %(default)s")
+    p.add_argument("--shedRetryMs", type=float,
+                   default=rdefaults.retry_after_ms,
+                   help="retry_after_ms hint on shed/quota rejections. "
+                        "Default = %(default)s")
+    p.add_argument("--tenantQueueDepth", type=int,
+                   default=rdefaults.fair_queue_depth,
+                   help="Parked submits per tenant before rejection. "
+                        "Default = %(default)s")
     p.add_argument("--logLevel", default="INFO")
     return p
+
+
+def child_serve_args(args) -> list[str]:
+    """The argv tail every spawned `ccs serve` child gets.  The edge
+    security flags pass DOWN: a TLS'd/token-guarded fleet must not spawn
+    plaintext-open replicas on adjacent ports (the user's --serveArg
+    values still come last so an argparse rematch lets them win)."""
+    serve_args = ["--maxInflightPerSession", "256",
+                  "--logLevel", "ERROR"]
+    if args.compileCache:
+        serve_args += ["--compileCache", args.compileCache]
+    if args.tlsCert:
+        serve_args += ["--tlsCert", args.tlsCert, "--tlsKey", args.tlsKey]
+    if args.authTokens:
+        serve_args += ["--authTokens", args.authTokens]
+    serve_args += list(args.serveArg)
+    return serve_args
 
 
 def _fleet_admin(args, log: Logger) -> int:
@@ -892,8 +944,16 @@ def _fleet_admin(args, log: Logger) -> int:
             print("ccs fleet readmit: needs --slot N", file=sys.stderr)
             return 2
         frame["slot"] = args.slot
+    if args.authToken:
+        # token-guarded router: every admin frame authenticates
+        frame[protocol.FIELD_AUTH] = args.authToken
     try:
         with socket.create_connection((host, port), timeout=30.0) as c:
+            if args.tlsCa:
+                from pbccs_tpu.serve import tenancy
+
+                c = tenancy.client_ssl_context(args.tlsCa).wrap_socket(
+                    c, server_hostname=host)
             c.sendall(json.dumps(frame).encode() + b"\n")
             rf = c.makefile("rb")
             while True:
@@ -922,12 +982,29 @@ def run_fleet(argv: list[str] | None = None) -> int:
 
     # children: quiet by default, per-session cap sized to the trusted
     # router link (it multiplexes every client over one session); the
-    # user's --serveArg values come LAST so they win an argparse rematch
-    serve_args = ["--maxInflightPerSession", "256",
-                  "--logLevel", "ERROR"]
-    if args.compileCache:
-        serve_args += ["--compileCache", args.compileCache]
-    serve_args += list(args.serveArg)
+    # edge security flags pass down so the whole fleet shares one
+    # identity surface (child_serve_args is unit-tested directly)
+    serve_args = child_serve_args(args)
+    from pbccs_tpu.serve import tenancy
+    from pbccs_tpu.serve.server import load_edge_config
+
+    edge = load_edge_config(args, "ccs fleet")
+    if edge is None:
+        return 2
+    ssl_ctx, tenants = edge
+    link_ssl = (tenancy.client_ssl_context(args.tlsCa)
+                if args.tlsCa or args.tlsCert else None)
+    if tenants is not None:
+        # the router's own link identity must exist in the token file
+        # and be trusted, or every spawned replica would reject the
+        # router's probes/submits -- fail at startup, not in production
+        row = tenants.authenticate(args.authToken) \
+            if args.authToken else None
+        if row is None or not row.trusted:
+            print("ccs fleet: --authTokens needs --authToken mapping to "
+                  "a TRUSTED tenant (the router's replica-link identity)",
+                  file=sys.stderr)
+            return 2
 
     try:
         rconfig = RouterConfig(
@@ -935,7 +1012,10 @@ def run_fleet(argv: list[str] | None = None) -> int:
             health_interval_s=args.routerHealthInterval,
             health_timeout_s=args.routerHealthTimeout,
             perf_ledger_path=args.perfLedger,
-            perf_ledger_interval_s=args.perfLedgerInterval)
+            perf_ledger_interval_s=args.perfLedgerInterval,
+            fair_queue_depth=args.tenantQueueDepth,
+            shed_burn_threshold=args.shedBurnRate,
+            retry_after_ms=args.shedRetryMs)
         sconfig = SupervisorConfig(
             replicas=args.replicas,
             min_replicas=args.minReplicas,
@@ -953,7 +1033,8 @@ def run_fleet(argv: list[str] | None = None) -> int:
     except ValueError as e:
         print(f"ccs fleet: {e}", file=sys.stderr)
         return 2
-    router = CcsRouter([], rconfig, logger=log)
+    router = CcsRouter([], rconfig, logger=log, tenants=tenants,
+                       link_ssl=link_ssl, link_token=args.authToken)
     # the supervisor's audit ledger appends to the same NDJSON file as
     # the router's snapshot loop; O_APPEND + one-line flushed writes
     # keep the two interleavable without a shared handle
@@ -965,13 +1046,14 @@ def run_fleet(argv: list[str] | None = None) -> int:
         ledger=ledger, logger=log)
     with router:
         router.set_supervisor(supervisor)
-        server = RouterServer(router, args.host, args.port, logger=log)
+        server = RouterServer(router, args.host, args.port, logger=log,
+                              ssl_context=ssl_ctx, tenants=tenants)
         server.start()
         from pbccs_tpu.serve.server import start_metrics_endpoint
 
         metrics_http = start_metrics_endpoint(
             args.metricsPort, router.metrics_text, args.host, log,
-            health=router.accepting)
+            health=router.accepting, ssl_context=ssl_ctx)
         supervisor.start()
         print(f"CCS-FLEET-READY {server.host} {server.port}", flush=True)
 

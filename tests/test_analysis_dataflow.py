@@ -512,7 +512,7 @@ def test_wire_spec_parses_from_live_protocol():
                                "ping", "fleet"}
     assert "closed" in spec.replies
     assert spec.errors == {"bad_request", "overloaded", "closed",
-                           "internal"}
+                           "internal", "unauthorized"}
 
 
 def test_passes_registry_names_match_design_doc():

@@ -216,6 +216,41 @@ class TestPerfGate:
         assert any(v["metric"] == "refine_rounds_host"
                    and v["observed"] is None for v in bad)
 
+    def test_floor_reads_specialized_record_kinds(self):
+        # tenant_b_p99_gain rides tenant_snapshot rows, not the
+        # batch_run rows the selector matches: the floor falls back to
+        # the latest record of any kind in the whole ledger
+        base = self._baseline(platform="tpu",
+                              floors={"tenant_b_p99_gain": 1.0})
+        batch = make_record(platform="tpu")
+        snap = {"kind": "tenant_snapshot", "platform": "tpu",
+                "jax_version": "1.2.3", "tenant": "tenantB",
+                "tenant_b_p99_gain": 2.7}
+        ok, _ = perf_gate.compare(base, [batch],
+                                  all_records=[batch, snap])
+        assert ok == []
+        bad, _ = perf_gate.compare(
+            base, [batch],
+            all_records=[batch, dict(snap, tenant_b_p99_gain=0.4)])
+        assert [(v["metric"], v["class"]) for v in bad] == [
+            ("tenant_b_p99_gain", "floor")]
+
+    def test_floor_absent_everywhere_is_violation(self):
+        base = self._baseline(platform="tpu",
+                              floors={"tenant_b_p99_gain": 1.0})
+        batch = make_record(platform="tpu")
+        bad, _ = perf_gate.compare(base, [batch], all_records=[batch])
+        assert any(v["metric"] == "tenant_b_p99_gain"
+                   and v["observed"] is None for v in bad)
+
+    def test_floor_skipped_on_cpu_platform(self):
+        # wall-class floor gating mirrors the wall band: recorded-only
+        # on CPU CI, enforced on matching accelerator hosts
+        base = self._baseline(floors={"tenant_b_p99_gain": 1.0})
+        violations, notes = perf_gate.compare(base, [make_record()])
+        assert violations == []
+        assert any("tenant_b_p99_gain" in n for n in notes)
+
     def test_update_baseline_prints_accepted_deltas(self, tmp_path,
                                                     capsys):
         path = str(tmp_path / "base.json")

@@ -21,7 +21,11 @@ finding reproduces with `--seed S --only CLASS`):
                      bam:header_magic (clobbered BAM magic)
   wire protocol      wire:oversized_frame, wire:binary_garbage,
                      wire:bad_json, wire:bad_zmw, wire:idle_session,
-                     wire:inflight_cap
+                     wire:inflight_cap -- run against the plaintext
+                     front doors (serve + router) AND their TLS
+                     listeners (wire-tls:* / router-wire-tls:*, which
+                     also prove a plaintext client is dropped with a
+                     counted tls_handshake abort, never a traceback)
   process            drain: kill -TERM a live `ccs serve` -> it reports
                      CCS-SERVE-DRAINING, drains in flight, exits 0
 
@@ -374,7 +378,28 @@ def leg_consensus_parity(tmp: str, report: dict) -> None:
 
 # ------------------------------------------------------------ wire protocol
 
-def _stub_server(max_line=4096, idle_s=0.0, cap=64, gate=None):
+_TLS_CACHE: dict = {}
+
+
+def _tls_material(tmp: str):
+    """One self-signed EC cert per run -> (server_ctx, client_ctx)."""
+    if "ctx" not in _TLS_CACHE:
+        from pbccs_tpu.serve import tenancy
+
+        cert = os.path.join(tmp, "fuzz-cert.pem")
+        key = os.path.join(tmp, "fuzz-key.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+             "ec_paramgen_curve:prime256v1", "-nodes", "-keyout", key,
+             "-out", cert, "-days", "2", "-subj", "/CN=localhost"],
+            check=True, capture_output=True)
+        _TLS_CACHE["ctx"] = (tenancy.server_ssl_context(cert, key),
+                             tenancy.client_ssl_context(cert))
+    return _TLS_CACHE["ctx"]
+
+
+def _stub_server(max_line=4096, idle_s=0.0, cap=64, gate=None,
+                 ssl_ctx=None):
     from pbccs_tpu.pipeline import Failure, PreparedZmw
     from pbccs_tpu.serve.engine import CcsEngine, ServeConfig
     from pbccs_tpu.serve.server import CcsServer
@@ -392,19 +417,22 @@ def _stub_server(max_line=4096, idle_s=0.0, cap=64, gate=None):
         max_batch=1, max_wait_ms=20.0, max_line_bytes=max_line,
         idle_timeout_s=idle_s, max_inflight_per_session=cap),
         prep_fn=prep, polish_fn=polish).start()
-    srv = CcsServer(eng, port=0).start()
+    srv = CcsServer(eng, port=0, ssl_context=ssl_ctx).start()
     return eng, srv
 
 
-def _stub_front(kind, max_line=4096, idle_s=0.0, cap=64, gate=None):
+def _stub_front(kind, max_line=4096, idle_s=0.0, cap=64, gate=None,
+                ssl_ctx=None):
     """The wire-armor target: either a bare stub `ccs serve` stack, or
     the SAME stack fronted by a one-replica `ccs router` whose session
     armor carries the tight limits (the backend keeps generous ones, so
-    every rejection under test is the ROUTER's).  Returns (server-like
-    with .host/.port, teardown callable)."""
+    every rejection under test is the ROUTER's).  `ssl_ctx` makes the
+    FRONT door a TLS listener (the router's backend hop stays local
+    plaintext -- the armor under test is the edge).  Returns
+    (server-like with .host/.port, teardown callable)."""
     if kind == "serve":
         eng, srv = _stub_server(max_line=max_line, idle_s=idle_s, cap=cap,
-                                gate=gate)
+                                gate=gate, ssl_ctx=ssl_ctx)
 
         def teardown():
             srv.shutdown()
@@ -419,7 +447,7 @@ def _stub_front(kind, max_line=4096, idle_s=0.0, cap=64, gate=None):
         RouterConfig(health_interval_s=0.2, max_line_bytes=max_line,
                      idle_timeout_s=idle_s,
                      max_inflight_per_session=cap)).start()
-    rsrv = RouterServer(router, port=0).start()
+    rsrv = RouterServer(router, port=0, ssl_context=ssl_ctx).start()
 
     def teardown():
         rsrv.shutdown()
@@ -430,8 +458,10 @@ def _stub_front(kind, max_line=4096, idle_s=0.0, cap=64, gate=None):
     return rsrv, teardown
 
 
-def _session(srv, timeout=10.0):
+def _session(srv, timeout=10.0, client_ctx=None):
     conn = socket.create_connection((srv.host, srv.port), timeout=timeout)
+    if client_ctx is not None:
+        conn = client_ctx.wrap_socket(conn, server_hostname=srv.host)
     return conn, conn.makefile("rb")
 
 
@@ -440,21 +470,47 @@ def _reply(rf):
     return json.loads(line) if line else None
 
 
-def leg_wire(report: dict, kind: str = "serve") -> None:
+def leg_wire(report: dict, kind: str = "serve",
+             tls_tmp: str | None = None) -> None:
     """The wire-armor invariants, against either front door: the bare
     serve session (`kind="serve"`, tags `wire:*`) or the router session
     in front of a loose-armored replica (`kind="router"`, tags
     `router-wire:*`) -- the oversized-frame / garbage / idle-reap /
-    in-flight-cap behavior must be identical at both tiers."""
-    w = "wire" if kind == "serve" else "router-wire"
-    print(f"== leg: wire-protocol armor ({kind} front door) ==")
+    in-flight-cap behavior must be identical at both tiers.  With
+    `tls_tmp` the front door is a TLS listener (tags gain `-tls`): the
+    same armor must hold through the handshake, and a PLAINTEXT client
+    must be dropped with a counted tls_handshake abort."""
+    tls = tls_tmp is not None
+    w = ("wire" if kind == "serve" else "router-wire") + \
+        ("-tls" if tls else "")
+    print(f"== leg: wire-protocol armor ({kind} front door"
+          f"{', TLS' if tls else ''}) ==")
     from pbccs_tpu.serve import protocol
 
+    server_ctx = client_ctx = None
+    if tls:
+        server_ctx, client_ctx = _tls_material(tls_tmp)
     scope = _REG.scope()
-    srv, teardown = _stub_front(kind, max_line=4096, idle_s=0.5, cap=2)
+    srv, teardown = _stub_front(kind, max_line=4096, idle_s=0.5, cap=2,
+                                ssl_ctx=server_ctx)
     try:
+        if tls:
+            # a plaintext client never gets a frame in: the handshake
+            # fails and the socket dies (FIN or RST), no traceback
+            raw = socket.create_connection((srv.host, srv.port),
+                                           timeout=10.0)
+            raw.settimeout(10.0)
+            raw.sendall(b'{"verb":"ping","id":"p"}\n')
+            try:
+                data = raw.recv(4096)
+            except OSError:
+                data = b""
+            raw.close()
+            check(report, f"{w}:plaintext_rejected", data == b"",
+                  f"got {data[:40]!r}")
+
         # oversized frame -> bad_request, session closed, abort counted
-        conn, rf = _session(srv)
+        conn, rf = _session(srv, client_ctx=client_ctx)
         conn.sendall(b"a" * 8192)
         msg = _reply(rf)
         check(report, f"{w}:oversized_frame:bad_request",
@@ -465,7 +521,7 @@ def leg_wire(report: dict, kind: str = "serve") -> None:
         conn.close()
 
         # binary garbage -> bad_request, session SURVIVES
-        conn, rf = _session(srv)
+        conn, rf = _session(srv, client_ctx=client_ctx)
         conn.sendall(b"\xff\xfe\x00garbage\n")
         msg = _reply(rf)
         check(report, f"{w}:binary_garbage:bad_request",
@@ -477,7 +533,7 @@ def leg_wire(report: dict, kind: str = "serve") -> None:
 
         # structurally bad JSON + invalid zmw payloads -> structured
         # rejections, each with a machine-readable reason
-        conn, rf = _session(srv)
+        conn, rf = _session(srv, client_ctx=client_ctx)
         for payload in (
                 b"{not json\n",
                 b'{"verb":"submit","id":"x","zmw":"nope"}\n',
@@ -499,7 +555,7 @@ def leg_wire(report: dict, kind: str = "serve") -> None:
         conn.close()
 
         # idle session -> reaped with a `closed` notice
-        conn, rf = _session(srv)
+        conn, rf = _session(srv, client_ctx=client_ctx)
         t0 = time.monotonic()
         msg = _reply(rf)  # blocks until the reaper speaks
         check(report, f"{w}:idle_session:reaped",
@@ -514,9 +570,10 @@ def leg_wire(report: dict, kind: str = "serve") -> None:
     # in-flight cap: gate the polish so submits stack up
     import threading
     gate = threading.Event()
-    srv, teardown = _stub_front(kind, cap=2, gate=gate)
+    srv, teardown = _stub_front(kind, cap=2, gate=gate,
+                                ssl_ctx=server_ctx)
     try:
-        conn, rf = _session(srv)
+        conn, rf = _session(srv, client_ctx=client_ctx)
         for i in range(3):
             conn.sendall(json.dumps(
                 {"verb": "submit", "id": f"r{i}",
@@ -538,8 +595,10 @@ def leg_wire(report: dict, kind: str = "serve") -> None:
         teardown()
     aborts = scope.counters("ccs_serve_session_aborts_total")
     causes = {dict(k).get("cause") for k in aborts if aborts[k] > 0}
-    check(report, f"{w}:aborts_counted",
-          {"oversized_frame", "idle_timeout"} <= causes,
+    expected = {"oversized_frame", "idle_timeout"}
+    if tls:
+        expected = expected | {"tls_handshake"}
+    check(report, f"{w}:aborts_counted", expected <= causes,
           f"causes={sorted(causes)}")
     check(report, f"{w}:cap_counted", scope.counter_value(
         "ccs_serve_inflight_cap_rejects_total") >= 1)
@@ -666,6 +725,8 @@ def main(argv=None) -> int:
         if args.smoke and args.only is None:
             leg_wire(report)
             leg_wire(report, kind="router")
+            leg_wire(report, tls_tmp=tmp)
+            leg_wire(report, kind="router", tls_tmp=tmp)
             leg_consensus_parity(tmp, report)
             if not args.skip_subprocess:
                 leg_drain(report)
@@ -673,6 +734,10 @@ def main(argv=None) -> int:
             leg_wire(report)
         elif args.only and args.only.startswith("router-wire:"):
             leg_wire(report, kind="router")
+        elif args.only and args.only.startswith("wire-tls:"):
+            leg_wire(report, tls_tmp=tmp)
+        elif args.only and args.only.startswith("router-wire-tls:"):
+            leg_wire(report, kind="router", tls_tmp=tmp)
         elif args.only == "drain":
             leg_drain(report)
     except CheckFailed as e:

@@ -32,7 +32,10 @@ the sentinel even when the relative wall band would tolerate it.
 Floors follow their field's class gating (wall-class floors only on a
 matching accelerator platform; none in --counters-only mode) and are
 carried through --update-baseline verbatim -- they are policy, not
-measurement.
+measurement.  A floor field absent from the selector-matched records
+is read from the latest record of any kind in the ledger (fields like
+``tenant_b_p99_gain`` ride ``tenant_snapshot`` rows, not the
+``batch_run`` rows the class bands select).
 
 Exit 0 clean; exit 1 with ONE structured JSON diff line per violation
 (metric, class, baseline, observed, tolerance); exit 2 on usage errors
@@ -161,8 +164,15 @@ def _violation(metric: str, cls: str, base, obs, tol) -> dict:
 
 
 def compare(baseline: dict, records: list[dict], *,
-            counters_only: bool = False) -> tuple[list[dict], list[str]]:
-    """(violations, notes) of the observed ledger records vs baseline."""
+            counters_only: bool = False,
+            all_records: list[dict] | None = None
+            ) -> tuple[list[dict], list[str]]:
+    """(violations, notes) of the observed ledger records vs baseline.
+
+    `records` are the selector-matched records the class bands run
+    over; `all_records` (default: same) is the whole ledger, which
+    floors may fall back to for fields only specialized record kinds
+    carry (e.g. tenant_snapshot's tenant_b_p99_gain)."""
     tol = {**DEFAULT_TOLERANCES, **(baseline.get("tolerances") or {})}
     base_metrics = baseline.get("metrics") or {}
     obs = observed_metrics(records)
@@ -256,6 +266,13 @@ def compare(baseline: dict, records: list[dict], *,
                          f"{platform!r}")
             continue
         obs_val = obs.get(metric)
+        if obs_val is None:
+            # a floor may target a field only a specialized record kind
+            # carries (tenant_snapshot's tenant_b_p99_gain): fall back
+            # to the latest record of ANY kind in the ledger with it
+            obs_val = next(
+                (r[metric] for r in reversed(all_records or records)
+                 if _numeric(r.get(metric))), None)
         if not _numeric(obs_val) or obs_val < floor:
             violations.append(_violation(metric, "floor", floor,
                                          obs_val, 0.0))
@@ -386,7 +403,8 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     violations, notes = compare(baseline, matching,
-                                counters_only=args.counters_only)
+                                counters_only=args.counters_only,
+                                all_records=records)
     for note in notes:
         print(f"perf_gate: note: {note}", file=sys.stderr)
     if violations:

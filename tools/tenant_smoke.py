@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Multi-tenant edge smoke for the tier-1 gate: a 2-tenant TLS fleet
+with per-tenant fair queuing, token auth on every surface, and
+SLO-burn load shedding.
+
+Legs:
+
+  baseline  offline process_chunks over tenant B's workload (the
+            byte-identity reference), computed in-process
+  edges     every front-door surface -- replica port, router port, the
+            HTTPS metrics scrape, and the fleet admin verb -- drops
+            PLAINTEXT clients at the handshake and answers
+            token-less/unknown-token frames with a structured
+            `unauthorized` (session survives; zero unauthenticated
+            frames are ever accepted)
+  noisy     tenant A floods 4x its in-flight quota on one session while
+            tenant B submits its cell: B completes 100% within the SLO
+            and byte-identical to offline, B is never rejected, A's
+            over-quota spill gets structured `overloaded` replies that
+            ALL carry retry_after_ms, and the router's tenancy
+            accounting (status rows + ccs_tenant_* series on the
+            federated HTTPS scrape) matches what happened
+  shed      a second 1-replica fleet with an impossible --sloP99Ms and
+            --shedBurnRate 0.5: once the probe-fed burn meter crosses
+            the threshold the router sheds priority-1 work with
+            retry_after_ms while priority-0 work still completes
+
+The workload reuses the chaos-cell geometry (tpl 60, 5 passes, seed
+20260803) so its compiled shapes are already in the persistent cache
+from the chaos/fuzz/fleet smokes.
+
+Run:  JAX_PLATFORMS=cpu python tools/tenant_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # runnable as tools/tenant_smoke.py from the repo root
+
+N_B_ZMWS = 6
+N_FLOOD_FACTOR = 4          # tenant A submits 4x its in-flight quota
+A_QUOTA = 2
+A_QUEUE_DEPTH = 2
+B_SLO_S = 300.0             # wall bound per B request under A's flood
+REPLY_TIMEOUT_S = 600.0
+RETRY_MS = 750.0
+SHED_RETRY_MS = 500.0
+
+TOKEN_A = "smoke-tenant-a"
+TOKEN_B = "smoke-tenant-b"
+TOKEN_LINK = "smoke-router-link"
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}"
+          + (f"  ({detail})" if detail else ""), flush=True)
+    if not ok:
+        raise SystemExit(f"tenant smoke failed: {name} {detail}")
+
+
+def make_workload(n, prefix):
+    from pbccs_tpu.models.arrow.params import decode_bases
+    from pbccs_tpu.pipeline import Chunk, Subread
+    from pbccs_tpu.simulate import simulate_zmw
+
+    rng = np.random.default_rng(20260803)
+    chunks, wires = [], []
+    for i in range(n):
+        _, reads, _, snr = simulate_zmw(rng, 60, 5)
+        zid = f"{prefix}/{i}"
+        chunks.append(Chunk(
+            zid, [Subread(f"{zid}/{k}", r) for k, r in enumerate(reads)],
+            snr))
+        wires.append({"id": zid, "snr": [float(s) for s in snr],
+                      "reads": [{"seq": decode_bases(r)} for r in reads]})
+    return chunks, wires
+
+
+def make_edge_material(tmp: str) -> tuple[str, str, str]:
+    """Self-signed EC cert (its own CA) + the 3-tenant token file."""
+    cert, key = os.path.join(tmp, "cert.pem"), os.path.join(tmp, "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+         "ec_paramgen_curve:prime256v1", "-nodes", "-keyout", key,
+         "-out", cert, "-days", "2", "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    tokens = os.path.join(tmp, "tokens.json")
+    with open(tokens, "w") as f:
+        json.dump({"tenants": [
+            {"name": "tenantA", "token": TOKEN_A,
+             "max_inflight": A_QUOTA, "priority": 1, "weight": 1},
+            {"name": "tenantB", "token": TOKEN_B,
+             "max_inflight": 4, "priority": 0, "weight": 2},
+            {"name": "_router", "token": TOKEN_LINK,
+             "priority": 0, "trusted": True},
+        ]}, f)
+    return cert, key, tokens
+
+
+def spawn_ready(subcmd_args, marker):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbccs_tpu.cli"] + subcmd_args,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    preamble: list[str] = []
+    line = proc.stdout.readline()
+    while line and not line.startswith(marker):
+        preamble.append(line)
+        line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise SystemExit(f"{marker} never seen (rc={proc.poll()})")
+    return proc, int(line.split()[2]), preamble
+
+
+def spawn_replica(cert, key, tokens, slo_ms=0.0):
+    argv = ["serve", "--port", "0", "--maxBatch", "4", "--maxWaitMs", "250",
+            "--maxInflightPerSession", "256", "--drainTimeout", "300",
+            "--logLevel", "ERROR", "--tlsCert", cert, "--tlsKey", key,
+            "--authTokens", tokens]
+    if slo_ms:
+        argv += ["--sloP99Ms", str(slo_ms)]
+    proc, port, _pre = spawn_ready(argv, "CCS-SERVE-READY")
+    return proc, port
+
+
+def spawn_router(ports, cert, key, tokens, shed_burn=0.0,
+                 shed_retry_ms=SHED_RETRY_MS):
+    argv = ["router", "--port", "0", "--logLevel", "ERROR",
+            "--routerHealthInterval", "0.5", "--routerHealthTimeout", "3",
+            "--metricsPort", "-1",
+            "--tlsCert", cert, "--tlsKey", key, "--authTokens", tokens,
+            "--tlsCa", cert, "--authToken", TOKEN_LINK,
+            "--tenantQueueDepth", str(A_QUEUE_DEPTH),
+            "--shedRetryMs", str(RETRY_MS)]
+    if shed_burn:
+        argv += ["--shedBurnRate", str(shed_burn),
+                 "--shedRetryMs", str(shed_retry_ms)]
+    for p in ports:
+        argv += ["--replica", f"127.0.0.1:{p}"]
+    proc, port, preamble = spawn_ready(argv, "CCS-ROUTER-READY")
+    metrics_port = next(
+        (int(line.split()[2]) for line in preamble
+         if line.startswith("CCS-METRICS-READY")), -1)
+    return proc, port, metrics_port
+
+
+def tls_conn(port, cert, timeout=REPLY_TIMEOUT_S):
+    from pbccs_tpu.serve import tenancy
+
+    ctx = tenancy.client_ssl_context(cert)
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    return ctx.wrap_socket(s, server_hostname="127.0.0.1")
+
+
+def tls_verb(port, cert, frame, timeout=60.0):
+    with tls_conn(port, cert, timeout) as c:
+        c.sendall(json.dumps(frame).encode() + b"\n")
+        rf = c.makefile("rb")
+        while True:
+            msg = json.loads(rf.readline())
+            if msg.get("id") == frame.get("id"):
+                return msg
+
+
+def https_get_metrics(port, cert) -> str:
+    with tls_conn(port, cert, timeout=60.0) as c:
+        c.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+        data = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    head, _, body = data.partition(b"\r\n\r\n")
+    check("metrics: HTTPS scrape answers 200", b"200 OK" in head,
+          head.split(b"\r\n")[0].decode(errors="replace"))
+    return body.decode()
+
+
+# ------------------------------------------------------------ edge surfaces
+
+def leg_edge_surfaces(replica_port, router_port, metrics_port, cert):
+    print("== leg: every edge surface rejects plaintext + "
+          "unauthenticated ==", flush=True)
+    # plaintext clients die at the handshake on both NDJSON front doors
+    for name, port in (("serve", replica_port), ("router", router_port)):
+        raw = socket.create_connection(("127.0.0.1", port), timeout=30.0)
+        raw.settimeout(30.0)
+        raw.sendall(b'{"verb":"ping","id":"p"}\n')
+        try:
+            data = raw.recv(4096)
+        except OSError:
+            data = b""
+        raw.close()
+        check(f"{name}: plaintext client dropped", data == b"",
+              f"got {data[:40]!r}")
+
+    # token-less / unknown-token frames get a structured `unauthorized`
+    # (the session survives and works once the token appears)
+    for name, port, tok in (("serve", replica_port, TOKEN_LINK),
+                            ("router", router_port, TOKEN_B)):
+        with tls_conn(port, cert, timeout=60.0) as c:
+            rf = c.makefile("rb")
+            c.sendall(b'{"verb":"status","id":"u1"}\n')
+            msg = json.loads(rf.readline())
+            check(f"{name}: token-less frame unauthorized",
+                  msg.get("type") == "error"
+                  and msg.get("code") == "unauthorized", str(msg)[:90])
+            c.sendall(b'{"verb":"status","id":"u2","auth":"bogus"}\n')
+            msg = json.loads(rf.readline())
+            check(f"{name}: unknown token unauthorized",
+                  msg.get("code") == "unauthorized")
+            c.sendall(json.dumps({"verb": "ping", "id": "p",
+                                  "auth": tok}).encode() + b"\n")
+            check(f"{name}: session survives once authenticated",
+                  json.loads(rf.readline()).get("type") == "pong")
+
+    # the fleet admin verb sits behind the same gate
+    msg = tls_verb(router_port, cert,
+                   {"verb": "fleet", "id": "f1", "action": "list"})
+    check("fleet verb: token-less frame unauthorized",
+          msg.get("code") == "unauthorized")
+    msg = tls_verb(router_port, cert,
+                   {"verb": "fleet", "id": "f2", "action": "list",
+                    "auth": TOKEN_LINK})
+    check("fleet verb: answers with the trusted token",
+          msg.get("type") == "fleet", str(msg)[:90])
+
+    # the metrics scrape is HTTPS-only: no plaintext surface anywhere
+    raw = socket.create_connection(("127.0.0.1", metrics_port),
+                                   timeout=30.0)
+    raw.settimeout(30.0)
+    raw.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+    try:
+        data = raw.recv(4096)
+    except OSError:
+        data = b""
+    raw.close()
+    check("metrics: plaintext scrape rejected", b"200 OK" not in data,
+          f"got {data[:40]!r}")
+
+
+# ----------------------------------------------------------- noisy neighbor
+
+def leg_noisy_neighbor(router_port, metrics_port, cert, wires_a, wires_b,
+                       offline_out):
+    from pbccs_tpu.serve.client import CcsClient
+
+    print("== leg: noisy neighbor (A floods 4x quota, B rides fair "
+          "queue) ==", flush=True)
+    n_flood = N_FLOOD_FACTOR * A_QUOTA * len(wires_a)
+    a = tls_conn(router_port, cert)
+    arf = a.makefile("rb")
+    a_ids = [f"a{i}" for i in range(n_flood)]
+    for i, rid in enumerate(a_ids):
+        a.sendall(json.dumps(
+            {"verb": "submit", "id": rid, "zmw": wires_a[i % len(wires_a)],
+             "auth": TOKEN_A}).encode() + b"\n")
+
+    # B submits its whole cell while A's flood is in the queue
+    cli = CcsClient("127.0.0.1", router_port, timeout=REPLY_TIMEOUT_S,
+                    tls_ca=cert, auth_token=TOKEN_B)
+    handles = [(time.monotonic(), cli.submit_wire(z)) for z in wires_b]
+    lat, got_b = [], {}
+    for t0, h in handles:
+        msg = h.reply(REPLY_TIMEOUT_S)
+        lat.append(time.monotonic() - t0)
+        check("noisy: B reply is a Success result",
+              msg.get("type") == "result"
+              and msg.get("status") == "Success",
+              str(msg.get("status") or msg.get("code")))
+        got_b[msg["zmw"]] = (msg["sequence"], msg["qual"])
+    check("noisy: B byte-identical to offline", got_b == offline_out,
+          f"{len(got_b)}/{len(offline_out)} matched")
+    p99 = max(lat)
+    check("noisy: B p99 within SLO under A's flood", p99 <= B_SLO_S,
+          f"p99={p99:.1f}s (SLO {B_SLO_S:.0f}s)")
+
+    # drain A's replies: every over-quota spill is a structured
+    # overloaded WITH a retry hint, and the admitted ones complete
+    a_replies = {}
+    while len(a_replies) < n_flood:
+        msg = json.loads(arf.readline())
+        if msg.get("id") in set(a_ids):
+            a_replies[msg["id"]] = msg
+    a.close()
+    rejected = [m for m in a_replies.values() if m.get("type") == "error"]
+    completed = [m for m in a_replies.values() if m.get("type") == "result"]
+    check("noisy: A over-quota spill rejected",
+          len(rejected) >= n_flood - A_QUOTA - A_QUEUE_DEPTH,
+          f"{len(rejected)} rejected / {len(completed)} completed")
+    check("noisy: every A reject is overloaded + retry_after_ms",
+          all(m.get("code") == "overloaded"
+              and isinstance(m.get("retry_after_ms"), (int, float))
+              and m["retry_after_ms"] > 0 for m in rejected),
+          f"hint={rejected[0].get('retry_after_ms') if rejected else '-'}ms")
+
+    # the tenancy accounting saw all of it
+    st = cli.status(60.0)
+    ten = st.get("tenancy") or {}
+    rows = {r["name"]: r for r in ten.get("tenants", [])}
+    check("noisy: status carries per-tenant rows",
+          {"tenantA", "tenantB"} <= set(rows), str(sorted(rows)))
+    check("noisy: B never rejected, whole cell completed",
+          rows["tenantB"]["rejected"] == 0
+          and rows["tenantB"]["completed"] >= len(wires_b),
+          str(rows["tenantB"]))
+    check("noisy: A's spill is in its OWN row",
+          rows["tenantA"]["rejected"] >= len(rejected) - 1
+          and rows["tenantA"]["completed"] >= 1, str(rows["tenantA"]))
+    cli.close()
+
+    body = https_get_metrics(metrics_port, cert)
+    for needle in ('ccs_tenant_requests_total{tenant="tenantA"}',
+                   'ccs_tenant_requests_total{tenant="tenantB"}',
+                   'ccs_tenant_rejects_total{',
+                   "ccs_router_fleet_burn_rate"):
+        check(f"noisy: scrape carries {needle.split('{')[0]}",
+              needle.split("{")[0] in body
+              and (("{" not in needle) or any(
+                  line.startswith(needle.split('}')[0])
+                  for line in body.splitlines())), needle)
+
+
+# -------------------------------------------------------------------- shed
+
+def leg_shed(tmp, cert, key, tokens, wires_b):
+    print("== leg: SLO-burn shedding (impossible SLO, threshold 0.5) ==",
+          flush=True)
+    replica_proc, replica_port = spawn_replica(cert, key, tokens,
+                                               slo_ms=0.001)
+    router_proc, router_port, _m = spawn_router(
+        [replica_port], cert, key, tokens, shed_burn=0.5)
+    try:
+        # priority-0 traffic generates violations (every request misses
+        # a 1-microsecond SLO) that ride probe status into the meter
+        for i, z in enumerate(wires_b[:3]):
+            msg = tls_verb(router_port, cert,
+                           {"verb": "submit", "id": f"warm{i}", "zmw": z,
+                            "auth": TOKEN_B}, timeout=REPLY_TIMEOUT_S)
+            check("shed: warmup (priority 0) completes",
+                  msg.get("status") == "Success",
+                  str(msg.get("status") or msg.get("code")))
+        deadline = time.monotonic() + 60.0
+        shedding, burn = False, 0.0
+        while time.monotonic() < deadline and not shedding:
+            st = tls_verb(router_port, cert,
+                          {"verb": "status", "id": "st",
+                           "auth": TOKEN_B})
+            ten = st.get("tenancy") or {}
+            burn = ten.get("burn_rate", 0.0)
+            shedding = bool(ten.get("shedding"))
+            if not shedding:
+                time.sleep(0.25)
+        check("shed: probe-fed burn meter crossed the threshold",
+              shedding and burn >= 0.5, f"burn={burn}")
+        # priority-1 work is now shed with the configured hint...
+        msg = tls_verb(router_port, cert,
+                       {"verb": "submit", "id": "s1", "zmw": wires_b[0],
+                        "auth": TOKEN_A}, timeout=60.0)
+        check("shed: priority-1 submit shed with retry hint",
+              msg.get("code") == "overloaded"
+              and msg.get("retry_after_ms") == SHED_RETRY_MS
+              and "shedding" in msg.get("error", ""), str(msg)[:110])
+        # ...while priority-0 work still completes
+        msg = tls_verb(router_port, cert,
+                       {"verb": "submit", "id": "s0", "zmw": wires_b[0],
+                        "auth": TOKEN_B}, timeout=REPLY_TIMEOUT_S)
+        check("shed: priority-0 submit still completes",
+              msg.get("status") == "Success",
+              str(msg.get("status") or msg.get("code")))
+    finally:
+        for proc in (router_proc, replica_proc):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
+
+def main() -> int:
+    from pbccs_tpu.pipeline import ConsensusSettings, process_chunks
+    from pbccs_tpu.runtime.cache import enable_compilation_cache
+    from pbccs_tpu.runtime.logging import Logger, LogLevel
+
+    enable_compilation_cache()
+    Logger.default(Logger(level=LogLevel.ERROR))
+    chunks_b, wires_b = make_workload(N_B_ZMWS, "tenantB")
+    _chunks_a, wires_a = make_workload(2, "tenantA")
+
+    print("== baseline (offline process_chunks, tenant B's cell) ==",
+          flush=True)
+    t0 = time.monotonic()
+    offline = process_chunks(list(chunks_b), ConsensusSettings())
+    offline_out = {r.id: (r.sequence, r.qualities)
+                   for r in offline.results}
+    check("baseline yields all successes", len(offline_out) == N_B_ZMWS,
+          f"{len(offline_out)}/{N_B_ZMWS} in {time.monotonic() - t0:.0f}s")
+
+    tmp = tempfile.mkdtemp(prefix="tenant_smoke_")
+    cert, key, tokens = make_edge_material(tmp)
+    replicas = [spawn_replica(cert, key, tokens) for _ in range(2)]
+    ports = [port for _, port in replicas]
+    router_proc, router_port, metrics_port = spawn_router(
+        ports, cert, key, tokens)
+    try:
+        leg_edge_surfaces(ports[0], router_port, metrics_port, cert)
+        leg_noisy_neighbor(router_port, metrics_port, cert,
+                           wires_a, wires_b, offline_out)
+        print("== router drains cleanly ==", flush=True)
+        import signal
+
+        router_proc.send_signal(signal.SIGTERM)
+        rc = router_proc.wait(timeout=60)
+        check("router exited 0 on SIGTERM", rc == 0, f"exit {rc}")
+    finally:
+        for proc, _ in replicas:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+        if router_proc.poll() is None:
+            router_proc.kill()
+            router_proc.wait(10)
+
+    leg_shed(tmp, cert, key, tokens, wires_b)
+    print("tenant smoke: all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

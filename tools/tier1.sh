@@ -47,6 +47,9 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py || exit 1
 echo "== autopilot smoke (ccs fleet supervisor: respawn, quarantine, autoscale, rolling restart) =="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/autopilot_smoke.py || exit 1
 
+echo "== tenant smoke (TLS fleet: auth on every edge, noisy-neighbor fairness, SLO shed) =="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/tenant_smoke.py || exit 1
+
 echo "== endurance smoke (scaled full-cell stream: OOM + ENOSPC + kill -9, zero loss) =="
 # the scaled run itself is budgeted <= 120 s warm (the smoke prints its
 # runtime); the wrapper allows cold-compile headroom
