@@ -1464,9 +1464,13 @@ def main() -> None:
 
     import jax
 
+    from pbccs_tpu.runtime import tuning
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 
     enable_compilation_cache()
+    # honors PBCCS_TUNE_PROFILE (path|auto); off by default so recorded
+    # baselines stay on hand-tuned knobs unless the run opts in
+    tuning.configure(None)
 
     platform = jax.devices()[0].platform
     print(f"bench: platform={platform} Z={n_zmws} L={tpl_len} P={n_passes}",
@@ -1558,6 +1562,9 @@ def main() -> None:
         "value": round(stats["zmws_per_sec"], 4),
         "unit": "ZMW/s",
         "vs_baseline": round(vs_baseline, 4),
+        # which ccs-tune profile (if any) produced this number -- every
+        # figure must be traceable to its knob settings
+        "tune_profile": tuning.ledger_tag(),
     }
     if ref_cpp:
         line["vs_reference_cpp"] = round(stats["zmws_per_sec"] / ref_cpp, 4)

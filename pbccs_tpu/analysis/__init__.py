@@ -84,7 +84,7 @@ PASSES: dict[str, PassSpec] = {p.name: p for p in (
     PassSpec("registry",
              ("REG001", "REG002", "REG003", "REG004", "REG005",
               "REG006", "REG007", "REG008", "REG009", "REG010",
-              "REG011"),
+              "REG011", "REG012"),
              _run_registry, repo_wide=True),
     PassSpec("exsafe", ("ATM001", "ATM002"), _run_exsafe),
     PassSpec("leases", ("LSE001", "LSE002"), _run_leases),

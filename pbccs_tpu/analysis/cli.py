@@ -112,12 +112,14 @@ def _run(args) -> int:
             collect_fault_kinds,
             collect_fault_sites,
             collect_flag_defs,
+            collect_knob_targets,
             collect_ledger_fields,
             collect_metrics,
             collect_spans,
             render_env_table,
             render_fault_kinds_table,
             render_flags_table,
+            render_knobs_table,
             render_ledger_table,
             render_metrics_table,
             render_sites_table,
@@ -151,6 +153,9 @@ def _run(args) -> int:
         print()
         led_fields, led_path, _ = collect_ledger_fields(pkg)
         print(render_ledger_table(led_fields, led_path))
+        print()
+        knob_targets, knobs_path, _ = collect_knob_targets(pkg)
+        print(render_knobs_table(knob_targets, knobs_path))
         return 0
 
     rules = ({r.strip() for r in args.rules.split(",") if r.strip()}

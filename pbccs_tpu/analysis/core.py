@@ -69,6 +69,9 @@ RULES = {
     "REG011": "perf-ledger schema (obs.ledger.LEDGER_FIELDS) drifted "
               "from the DESIGN.md ledger-schema table (field or "
               "tolerance class disagrees, either direction)",
+    "REG012": "tunable-knob inventory (tune.space.KNOB_TARGETS) drifted "
+              "from the DESIGN.md knobs table (knob or target disagrees, "
+              "either direction)",
     "EXC001": "bare `except:` clause",
     "EXC002": "silent `except Exception/BaseException: pass` without a "
               "stated reason",

@@ -53,6 +53,9 @@ JOURNAL_WRITERS = {
     # append-only NDJSON perf journal: flushed line records, torn-tail-
     # tolerant reader (read_ledger), degrade-to-absence on write failure
     ("pbccs_tpu/obs/ledger.py", "PerfLedger"),
+    # ccs tune resume journal: same contract (append + flush per line,
+    # loaded via read_ledger, OSError degrades to a re-measure)
+    ("pbccs_tpu/tune/driver.py", "Journal"),
 }
 
 _TMP_MARKER = ".tmp"

@@ -177,6 +177,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "peak RSS, governor interventions -- the record "
                         "tools/perf_gate.py defends baselines against. "
                         "Default: off.")
+    p.add_argument("--tuneProfile", default=None, metavar="PATH|auto",
+                   help="Apply a `ccs tune` host profile: tuned knob "
+                        "defaults (band width, prepare workers, memory "
+                        "budget, ...) resolved as explicit flag/env > "
+                        "profile > hand-tuned constants.  `auto` scans "
+                        "the committed profiles/ directory for this "
+                        "host's fingerprint; a mismatched or corrupt "
+                        "profile degrades to defaults with a note "
+                        "(PBCCS_TUNE_PROFILE is the env equivalent). "
+                        "Default: off.")
     p.add_argument("--checkpoint", default=None, metavar="FILE",
                    help="Journal completed chunks to FILE (NDJSON) so a "
                         "killed run can restart with --resume. Default: "
@@ -371,6 +381,11 @@ def run(argv: list[str] | None = None) -> int:
         from pbccs_tpu.sched.warmup import run_warmup
 
         return run_warmup(argv[1:])
+    if argv and argv[0] == "tune":
+        # `ccs tune`: ledger-driven autotuner (pbccs_tpu/tune)
+        from pbccs_tpu.tune.cli import run_tune
+
+        return run_tune(argv[1:])
     if argv and argv[0] == "analyze":
         # `ccs analyze`: project-native static analysis (pbccs_tpu/analysis)
         from pbccs_tpu.analysis.cli import run_analyze
@@ -398,6 +413,12 @@ def run(argv: list[str] | None = None) -> int:
         level=LogLevel.from_string(args.logLevel)))
     install_signal_handlers(log)
 
+    from pbccs_tpu.runtime import tuning
+
+    # opt-in tuned-knob resolution (runtime/tuning.py): explicit flag /
+    # env still beats anything a profile carries
+    tuning.configure(args.tuneProfile, logger=log)
+
     try:
         whitelist = Whitelist(args.zmws)
     except ValueError as e:
@@ -422,6 +443,11 @@ def run(argv: list[str] | None = None) -> int:
         except ValueError as e:
             print(f"option --memBudget: {e}", file=sys.stderr)
             return 2
+    elif args.devices != 1:
+        # resolution ladder: no explicit --memBudget, so a tuned
+        # profile's byte budget (already stored in bytes) applies; the
+        # single-device WorkQueue driver has no prepare backlog to gate
+        args.memBudget = tuning.knob_int("mem_budget_bytes")
 
     settings = consensus_settings_from_args(args)
 
@@ -620,9 +646,14 @@ def _run_pipeline(args, files, whitelist, settings, log) -> ResultTally:
         devs = select_devices(args.devices)
         # --numThreads sizes the legacy WorkQueue driver; in fleet mode
         # it seeds the host prepare pool instead of being silently
-        # dropped (an explicit --prepareWorkers still wins)
-        prep_workers = args.prepareWorkers or args.numThreads or max(
-            2, min(4, os.cpu_count() or 1))
+        # dropped (an explicit --prepareWorkers still wins).  A tuned
+        # profile slots between the explicit flags and the auto default
+        # (the runtime/tuning.py resolution ladder).
+        from pbccs_tpu.runtime import tuning
+
+        prep_workers = (args.prepareWorkers or args.numThreads
+                        or tuning.knob_int("prepare_workers")
+                        or max(2, min(4, os.cpu_count() or 1)))
         # --memBudget: byte-bound the prepared-batch backlog (prep pool
         # + parked results) so a full-cell stream cannot outrun the
         # devices into the OOM killer (resilience.resources.HostBudget)

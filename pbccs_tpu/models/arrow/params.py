@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pbccs_tpu.runtime import tuning as _tuning
+
 # Base encoding used framework-wide: A=0 C=1 G=2 T=3, padding/invalid = 4.
 BASE_A, BASE_C, BASE_G, BASE_T, BASE_PAD = 0, 1, 2, 3, 4
 N_BASES = 4
@@ -197,6 +199,12 @@ def effective_band_width(banding: "BandingOptions", jmax: int) -> int:
     env = os.environ.get("PBCCS_BAND_W")
     if env:
         return int(env)
+    # tuned-profile default (runtime/tuning.py resolution ladder): an
+    # applied `ccs tune` host profile replaces the schedule's choice,
+    # exactly like PBCCS_BAND_W but measured instead of hand-picked
+    tuned = _tuning.knob_int("band_w")
+    if tuned is not None:
+        return tuned
     return 64 if jmax <= 576 else 96
 
 

@@ -72,6 +72,7 @@ LEDGER_FIELDS = {
     "platform": "meta",        # jax backend platform ("cpu", "tpu", ...)
     "jax_version": "meta",
     "devices": "meta",
+    "tuned_profile": "meta",   # active ccs-tune profile id, or "none"
     # ---- wall-clock (wall: accelerator-only, median-of-N) ----
     "wall_s": "wall",
     "zmws_per_sec": "wall",
@@ -321,6 +322,12 @@ def environment_fields() -> dict[str, Any]:
                 platform = jax.devices()[0].platform
         if platform:
             out["platform"] = platform.split(",")[0].strip()
+    except Exception:  # noqa: BLE001 -- environment capture is best-effort
+        pass
+    try:
+        from pbccs_tpu.runtime import tuning
+
+        out["tuned_profile"] = tuning.ledger_tag()
     except Exception:  # noqa: BLE001 -- environment capture is best-effort
         pass
     return out

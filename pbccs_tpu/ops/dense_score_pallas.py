@@ -54,6 +54,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 import numpy as np
 
+from pbccs_tpu.runtime import tuning as _tuning
+
 from pbccs_tpu.models.arrow.params import (
     MISMATCH_PROBABILITY,
     TRANS_BRANCH,
@@ -113,10 +115,16 @@ def dense_cols_per_step(nb: int | None = None) -> int:
     stays one _PB sub-block: dead sub-blocks inside a live grid step
     still skip their compute.
 
-    Env override PBCCS_DENSE_CB (>= 1); clamped to the block count so
-    short templates keep a non-degenerate grid."""
+    Env override PBCCS_DENSE_CB (>= 1), then an applied `ccs tune`
+    host profile (runtime/tuning.py resolution ladder), then
+    _CB_DEFAULT; clamped to the block count so short templates keep a
+    non-degenerate grid."""
     env = os.environ.get("PBCCS_DENSE_CB")
-    cb = max(1, int(env)) if env else _CB_DEFAULT
+    if env:
+        cb = max(1, int(env))
+    else:
+        tuned = _tuning.knob_int("dense_cb")
+        cb = max(1, tuned) if tuned is not None else _CB_DEFAULT
     if nb is not None:
         cb = min(cb, max(nb, 1))
     return cb

@@ -54,11 +54,21 @@ def build_parser() -> argparse.ArgumentParser:
         description="Precompile the polish-program menu for declared "
                     "workload buckets (kills the cold-compile latency of "
                     "the first batch/request at each shape).")
-    p.add_argument("--bucket", action="append", required=True,
+    p.add_argument("--bucket", action="append", default=None,
                    metavar="ZxPASSESxLEN",
                    help="One compiled-shape bucket by workload geometry: "
                         "Z ZMWs per batch, PASSES subreads per ZMW, "
-                        "LEN-base templates.  Repeatable.")
+                        "LEN-base templates.  Repeatable.  May be "
+                        "omitted when --tuneProfile supplies a "
+                        "warmup_buckets menu.")
+    p.add_argument("--tuneProfile", default=None, metavar="PATH|auto",
+                   help="ccs-tune host profile to apply (band width, "
+                        "dense blocking) so the warmed executables match "
+                        "what a tuned batch/serve process will request; "
+                        "its warmup_buckets menu is the default --bucket "
+                        "list.  'auto' scans the profiles/ directory for "
+                        "a fingerprint match.  Default: "
+                        "PBCCS_TUNE_PROFILE, else no profile.")
     p.add_argument("--devices", type=int, default=0,
                    help="Devices visible to the warmed fleet (0 = all; "
                         "bounds what --allDevices compiles on). "
@@ -114,6 +124,16 @@ def _synth_tasks(n_zmws: int, n_passes: int, tpl_len: int):
 def run_warmup(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+
+    from pbccs_tpu.runtime import tuning
+
+    tuning.configure(args.tuneProfile, logger=log)
+    if not args.bucket:
+        args.bucket = tuning.knob_str_list("warmup_buckets")
+    if not args.bucket:
+        raise SystemExit(
+            "ccs warmup: --bucket is required (no applied tune profile "
+            "supplies a warmup_buckets menu)")
 
     from pbccs_tpu.runtime.cache import enable_compilation_cache
 

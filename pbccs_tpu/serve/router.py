@@ -417,6 +417,10 @@ class CcsRouter:
             tenants, queue_depth=self.config.fair_queue_depth,
             quantum=self.config.drr_quantum)
             if tenants is not None and self.config.fair_queue else None)
+        if self._fair is not None and hasattr(tenants, "add_listener"):
+            # online token-map reloads: new tenants need admission
+            # state before their first submit reaches try_admit
+            tenants.add_listener(self._fair.refresh)
         self._burn = tenancy.BurnMeter(self.config.shed_window_s)
         self._shed_total = 0
         # non-reentrant fair-queue pump: the holder of _pump_lock drains
@@ -764,10 +768,16 @@ class CcsRouter:
         # shed gate first: under SLO burn, best-effort classes are
         # rejected BEFORE they can occupy queue slots (priority 0 is
         # never shed -- it rides straight into fair admission)
-        burn = self._burn.rate() if cfg.shed_burn_threshold > 0 else 0.0
-        if (cfg.shed_burn_threshold > 0 and row is not None
+        # per-tenant SLO target when the token map declares one, else
+        # the fleet-wide --shedBurnRate (a latency-tolerant tenant can
+        # carry a loose threshold while the fleet sheds at its default)
+        threshold = cfg.shed_burn_threshold
+        if row is not None and row.shed_burn_rate is not None:
+            threshold = row.shed_burn_rate
+        burn = self._burn.rate() if threshold > 0 else 0.0
+        if (threshold > 0 and row is not None
                 and row.priority >= 1
-                and burn >= cfg.shed_burn_threshold):
+                and burn >= threshold):
             fair.record_shed(tenant)
             with self._lock:
                 self._shed_total += 1
@@ -775,7 +785,7 @@ class CcsRouter:
             self._emit(req, protocol.error_to_wire(
                 None, protocol.ERR_OVERLOADED,
                 f"shedding priority-{row.priority} work: fleet SLO burn "
-                f"{burn:.3f} >= {cfg.shed_burn_threshold:g}; retry later",
+                f"{burn:.3f} >= {threshold:g}; retry later",
                 retry_after_ms=cfg.retry_after_ms))
             return req
         verdict = fair.try_admit(tenant, req)
@@ -1727,11 +1737,19 @@ def build_router_parser() -> argparse.ArgumentParser:
                    default=defaults.readmit_after,
                    help="Consecutive good probes before an unhealthy "
                         "replica is re-admitted. Default = %(default)s")
-    p.add_argument("--routerSpillDepth", type=int,
-                   default=defaults.spill_depth,
+    p.add_argument("--routerSpillDepth", type=int, default=None,
                    help="In-flight depth past which a sticky bucket "
-                        "spills off its home replica. "
-                        "Default = %(default)s")
+                        "spills off its home replica. Default: the "
+                        "applied --tuneProfile's router_spill_depth, "
+                        f"else {defaults.spill_depth}")
+    p.add_argument("--tuneProfile", default=None, metavar="PATH|auto",
+                   help="ccs-tune host profile (runtime/tuning.py): "
+                        "supplies a --routerSpillDepth default when the "
+                        "explicit flag is absent.  'auto' scans the "
+                        "profiles/ directory for a fingerprint match; "
+                        "failures degrade to built-in defaults with a "
+                        "logged note.  Default: PBCCS_TUNE_PROFILE, "
+                        "else no profile.")
     # the same wire armor the replicas enforce, applied at the edge
     p.add_argument("--maxLineBytes", type=int,
                    default=defaults.max_line_bytes,
@@ -1818,6 +1836,14 @@ def run_router(argv: list[str] | None = None) -> int:
     """`ccs router` entry point (dispatched from pbccs_tpu.cli)."""
     args = build_router_parser().parse_args(argv)
     log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
+    from pbccs_tpu.runtime import tuning
+
+    tuning.configure(args.tuneProfile, logger=log)
+    if args.routerSpillDepth is None:
+        # explicit flag > applied host profile > RouterConfig default
+        tuned = tuning.knob_int("router_spill_depth")
+        args.routerSpillDepth = (tuned if tuned is not None
+                                 else RouterConfig().spill_depth)
     from pbccs_tpu.serve.server import load_edge_config
 
     edge = load_edge_config(args, "ccs router")

@@ -560,12 +560,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="Bind address. Default = %(default)s")
     p.add_argument("--port", type=int, default=7331,
                    help="Bind port (0 = ephemeral). Default = %(default)s")
-    p.add_argument("--maxBatch", type=int, default=defaults.max_batch,
+    p.add_argument("--maxBatch", type=int, default=None,
                    help="ZMWs per polish batch (bucket fill-flush size). "
-                        "Default = %(default)s")
-    p.add_argument("--maxWaitMs", type=float, default=defaults.max_wait_ms,
+                        "Default: the applied --tuneProfile's "
+                        "serve_max_batch, else "
+                        f"{defaults.max_batch}")
+    p.add_argument("--maxWaitMs", type=float, default=None,
                    help="Max time a request waits to be batched before a "
-                        "deadline flush. Default = %(default)s")
+                        "deadline flush. Default: the applied "
+                        "--tuneProfile's serve_max_wait_ms, else "
+                        f"{defaults.max_wait_ms}")
     p.add_argument("--maxPending", type=int, default=defaults.max_pending,
                    help="Admission bound: requests in the system before "
                         "submits are rejected as overloaded. "
@@ -649,6 +653,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "from disk in seconds instead of recompiling "
                         "(default: JAX_COMPILATION_CACHE_DIR, else the "
                         "checkout-local .jax_cache).")
+    p.add_argument("--tuneProfile", default=None, metavar="PATH|auto",
+                   help="ccs-tune host profile (runtime/tuning.py): "
+                        "supplies defaults for --maxBatch/--maxWaitMs "
+                        "plus the batch knobs (band width, dense "
+                        "blocking) when the explicit flag/env is absent. "
+                        "'auto' scans the profiles/ directory for a "
+                        "fingerprint match; a missing/corrupt/mismatched "
+                        "profile degrades to built-in defaults with a "
+                        "logged note.  Default: PBCCS_TUNE_PROFILE, "
+                        "else no profile.")
     # consensus + resilience knobs shared (definition and defaults) with
     # the offline CLI; serve maps --polishTimeout to the ENGINE-level
     # watchdog (ServeConfig.polish_timeout_ms) rather than the ambient
@@ -690,12 +704,27 @@ def run_serve(argv: list[str] | None = None) -> int:
     enable_compilation_cache(args.compileCache)
     log = Logger.default(Logger(level=LogLevel.from_string(args.logLevel)))
 
+    from pbccs_tpu.runtime import tuning
+
+    tuning.configure(args.tuneProfile, logger=log)
+    serve_defaults = ServeConfig()
+    # resolution ladder (docs/DESIGN.md "Auto-tuning"): explicit flag >
+    # applied host profile > ServeConfig default
+    max_batch = (args.maxBatch
+                 if args.maxBatch is not None
+                 else tuning.knob_int("serve_max_batch")
+                 or serve_defaults.max_batch)
+    max_wait_ms = (args.maxWaitMs
+                   if args.maxWaitMs is not None
+                   else tuning.knob_float("serve_max_wait_ms")
+                   or serve_defaults.max_wait_ms)
+
     from pbccs_tpu.cli import consensus_settings_from_args
 
     settings = consensus_settings_from_args(args)
     config = ServeConfig(
-        max_batch=args.maxBatch,
-        max_wait_ms=args.maxWaitMs,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
         max_pending=args.maxPending,
         prep_workers=args.prepWorkers,
         devices=args.devices,
@@ -774,10 +803,14 @@ def load_edge_config(args, prog: str):
     tenants = None
     if args.authTokens:
         try:
-            tenants = tenancy.TenantDirectory.from_file(args.authTokens)
+            # online-reloadable (SIGHUP or mtime change): an edited
+            # token map takes effect on the next frame without a
+            # rolling restart.  The FIRST load still fails loud.
+            tenants = tenancy.ReloadableTenantDirectory(args.authTokens)
         except (OSError, ValueError) as e:
             print(f"{prog}: --authTokens: {e}", file=sys.stderr)
             return None
+        tenants.install_sighup()
     return ssl_ctx, tenants
 
 

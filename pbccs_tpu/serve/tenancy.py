@@ -83,7 +83,11 @@ class Tenant:
     a weight-2 tenant drains twice as fast as a weight-1 tenant when
     both are parked.  trusted marks infrastructure tokens (the router's
     replica-link token): only a trusted peer may forward another
-    tenant's identity in the wire `tenant` field."""
+    tenant's identity in the wire `tenant` field.  shed_burn_rate is an
+    optional PER-TENANT SLO burn threshold: when set, this tenant is
+    shed at its own rate instead of the fleet-wide --shedBurnRate (a
+    latency-tolerant batch tenant can carry 0.5 while interactive
+    tenants shed at the fleet default)."""
 
     name: str
     token: str
@@ -91,6 +95,7 @@ class Tenant:
     priority: int = 1
     weight: int = 1
     trusted: bool = False
+    shed_burn_rate: float | None = None
 
 
 class TenantDirectory:
@@ -165,10 +170,20 @@ class TenantDirectory:
                 raise ValueError(f"tenants[{i}].weight must be an int >= 1")
             if not isinstance(trusted, bool):
                 raise ValueError(f"tenants[{i}].trusted must be a bool")
+            burn = row.get("shed_burn_rate")
+            if burn is not None:
+                if (isinstance(burn, bool)
+                        or not isinstance(burn, (int, float))
+                        or not 0.0 <= burn <= 1.0):
+                    raise ValueError(
+                        f"tenants[{i}].shed_burn_rate must be a number "
+                        "in [0, 1] (a violation fraction; omit to use "
+                        "the fleet-wide --shedBurnRate)")
+                burn = float(burn)
             tenants.append(Tenant(name=name, token=token,
                                   max_inflight=max_inflight,
                                   priority=priority, weight=weight,
-                                  trusted=trusted))
+                                  trusted=trusted, shed_burn_rate=burn))
         return cls(tenants)
 
     def authenticate(self, token: Any) -> Tenant | None:
@@ -184,6 +199,148 @@ class TenantDirectory:
 
     def tenants(self) -> list[Tenant]:
         return list(self._by_name.values())
+
+
+class ReloadableTenantDirectory:
+    """A TenantDirectory that follows its --authTokens file online.
+
+    Wraps the immutable directory with the reload policy ROADMAP item
+    4's follow-on asks for: the map is re-read on SIGHUP
+    (``install_sighup``) or when the file's mtime changes (checked at
+    most once per ``recheck_s`` on the access path, so the per-frame
+    auth cost is one monotonic-clock compare).  Semantics:
+
+      * the FIRST load happens in the constructor and raises like
+        ``TenantDirectory.from_file`` -- a malformed file is still a
+        loud startup error;
+      * a malformed or unreadable file at RELOAD time keeps the
+        previous map (one warning + a
+        ``ccs_tenant_map_reloads_total{outcome=error}`` count) -- an
+        operator mid-edit must never take the front door down;
+      * in-flight sessions keep their resolved identity (the session
+        caches its Tenant); NEW frames resolve against the new map, so
+        deleting a token revokes on the next frame (the per-frame
+        re-auth in server._authenticate);
+      * listeners registered with ``add_listener`` run after every
+        successful swap (outside the lock) -- the router points
+        ``FairQueue.refresh`` here so new tenants get admission state
+        without a restart.
+    """
+
+    def __init__(self, path: str, *, recheck_s: float = 1.0,
+                 logger=None, clock: Callable[[], float] | None = None):
+        import time
+        self._path = path
+        self._recheck_s = recheck_s
+        self._log = logger
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._inner = TenantDirectory.from_file(path)
+        self._mtime = self._stat_mtime()
+        self._next_check = self._clock() + recheck_s
+        self._listeners: list[Callable[[TenantDirectory], None]] = []
+        # written from the signal handler WITHOUT the lock (a handler
+        # interrupting a lock holder on the main thread must not block)
+        self._sighup = False
+
+    def _stat_mtime(self) -> int | None:
+        try:
+            import os
+            return os.stat(self._path).st_mtime_ns
+        except OSError:
+            return None
+
+    def _logger(self):
+        if self._log is not None:
+            return self._log
+        # load_edge_config builds the directory before the run installs
+        # its leveled logger; resolve the process default lazily so
+        # reload notes land in the real log, not a throwaway
+        from pbccs_tpu.runtime.logging import Logger
+        return Logger.default()
+
+    def _warn(self, msg: str) -> None:
+        self._logger().warn(msg)
+
+    def add_listener(self, cb: Callable[[TenantDirectory], None]) -> None:
+        with self._lock:
+            self._listeners.append(cb)
+
+    def install_sighup(self) -> bool:
+        """Arm SIGHUP -> reload-on-next-access; False where signals are
+        unavailable (non-main thread, platforms without SIGHUP)."""
+        import signal
+        if not hasattr(signal, "SIGHUP"):
+            return False
+
+        def _handler(signum, frame):
+            self._sighup = True
+
+        try:
+            signal.signal(signal.SIGHUP, _handler)
+        except ValueError:   # not the main thread
+            return False
+        return True
+
+    def maybe_reload(self) -> bool:
+        """One throttled reload check; True when a new map was swapped
+        in.  Called from the access path (authenticate/get/tenants) and
+        safe to call from anywhere -- failures degrade to the previous
+        map, never to an exception."""
+        now = self._clock()
+        fresh = None
+        with self._lock:
+            hup, self._sighup = self._sighup, False
+            if not hup and now < self._next_check:
+                return False
+            self._next_check = now + self._recheck_s
+            mtime = self._stat_mtime()
+            if not hup and (mtime is None or mtime == self._mtime):
+                return False
+            try:
+                fresh = TenantDirectory.from_file(self._path)
+            except (OSError, ValueError) as e:
+                # remember the bad mtime so a broken edit warns once,
+                # not once per recheck window
+                self._mtime = mtime
+                _reg.counter(
+                    "ccs_tenant_map_reloads_total",
+                    "Online --authTokens map reloads, by outcome",
+                    outcome="error").inc()
+                self._warn(f"--authTokens reload failed; keeping the "
+                           f"previous map: {e}")
+                return False
+            self._inner = fresh
+            self._mtime = mtime
+            listeners = list(self._listeners)
+        _reg.counter("ccs_tenant_map_reloads_total",
+                     "Online --authTokens map reloads, by outcome",
+                     outcome="ok").inc()
+        self._logger().info(f"--authTokens map reloaded: "
+                            f"{len(fresh.tenants())} tenant(s)")
+        for cb in listeners:   # outside the lock: FairQueue.refresh
+            cb(fresh)          # takes its own lock
+        return True
+
+    # -- the TenantDirectory surface, behind the reload check --------
+
+    def authenticate(self, token: Any) -> Tenant | None:
+        self.maybe_reload()
+        with self._lock:
+            inner = self._inner
+        return inner.authenticate(token)
+
+    def get(self, name: str) -> Tenant | None:
+        self.maybe_reload()
+        with self._lock:
+            inner = self._inner
+        return inner.get(name)
+
+    def tenants(self) -> list[Tenant]:
+        self.maybe_reload()
+        with self._lock:
+            inner = self._inner
+        return inner.tenants()
 
 
 def resolve_tenant(session_tenant: Tenant | None,
@@ -285,6 +442,32 @@ class FairQueue:
 
     def _state(self, tenant: str) -> _TenantState | None:
         return self._states.get(tenant)
+
+    def refresh(self, directory: "TenantDirectory") -> None:
+        """Follow a reloaded token map (ReloadableTenantDirectory
+        listener): NEW tenants get admission state + gauges so their
+        first submit cannot KeyError; EXISTING tenants keep their
+        counters, queue, and banked deficit but adopt the new quota/
+        weight/priority on the next admission decision.  Tenants
+        REMOVED from the map keep their state until it drains -- their
+        tokens no longer authenticate, so no new work arrives, and
+        in-flight completions still need the slot accounting."""
+        with self._lock:
+            for t in directory.tenants():
+                st = self._states.get(t.name)
+                if st is None:
+                    self._states[t.name] = _TenantState(t)
+                    self._ring.append(t.name)
+                    self._m_inflight[t.name] = _reg.gauge(
+                        "ccs_tenant_inflight",
+                        "Requests a tenant has in flight past admission",
+                        tenant=t.name)
+                    self._m_qdepth[t.name] = _reg.gauge(
+                        "ccs_tenant_queue_depth",
+                        "Requests parked in a tenant's fair queue",
+                        tenant=t.name)
+                else:
+                    st.tenant = t
 
     def try_admit(self, tenant: str, item: Any) -> str:
         """Admission verdict for one request: "dispatch" (slot granted,

@@ -1,0 +1,28 @@
+"""`ccs tune`: ledger-driven autotuner with committed host profiles.
+
+The repo *records* performance exhaustively (obs/ledger.py rows with
+tolerance classes, tools/perf_gate.py as the regression sentinel) but
+every tuning knob -- band width, dense column blocking, prepare workers,
+serve flush thresholds -- started life as a hand-picked constant from
+one profiling session on one host.  This package closes the loop:
+
+  space.py      the declared knob inventory (drift-checked by REG012
+                against the DESIGN.md knobs-table) and candidate grids;
+  profile.py    the schema-versioned host profile: knobs keyed by a
+                hardware fingerprint (platform, device kind, device
+                count, jax version), atomically published, loaded
+                corrupt-tolerantly;
+  objective.py  perf-ledger rows -> one Measurement (ZMW/s primary,
+                p99 / padding_waste / peak RSS tie-breakers);
+  driver.py     the search: a fixed calibration workload per candidate
+                in a fresh subprocess, byte-identity vs defaults as the
+                accept gate, perf_gate as referee, a torn-tail-tolerant
+                NDJSON journal for resume, --tuneBudget as wall cap;
+  cli.py        the `ccs tune` subcommand.
+
+The consumer half lives in pbccs_tpu/runtime/tuning.py: `ccs`,
+`ccs warmup`, `ccs serve`, and `ccs router` resolve knobs as explicit
+flag/env > matching host profile (--tuneProfile PATH|auto) > hand-tuned
+constants, record the applied profile id in every perf-ledger record
+(`tuned_profile`), and expose a `ccs_tune_profile_applied` gauge.
+"""

@@ -31,4 +31,5 @@ AST_CASES = {
 REPO_CASES = {
     "REG010": ("reg010_pos.py", "reg010_neg.py"),
     "REG011": ("reg011_pos.py", "reg011_neg.py"),
+    "REG012": ("reg012_pos.py", "reg012_neg.py"),
 }

@@ -1,0 +1,12 @@
+"""REG012 negative: every declared tunable knob matches the
+constructed mini repo's DESIGN.md knobs table (name AND target), and a
+non-inventory dict named something else never counts."""
+
+KNOB_TARGETS = {
+    "reg012_documented": "env:PBCCS_DOCUMENTED",
+    "reg012_shifty": "flag:--shifty",
+}
+
+OTHER_TARGETS = {
+    "not_a_knob": "whatever",
+}

@@ -1,0 +1,10 @@
+"""REG012 positive: declares a tunable knob the constructed mini
+repo's DESIGN.md knobs table does not list (`reg012_alien`), plus a
+knob whose TARGET disagrees with the table (`reg012_shifty` drives
+`env:PBCCS_SHIFTY` here but `flag:--shifty` in the table)."""
+
+KNOB_TARGETS = {
+    "reg012_documented": "env:PBCCS_DOCUMENTED",
+    "reg012_shifty": "env:PBCCS_SHIFTY",
+    "reg012_alien": "flag:--alien",
+}

@@ -279,6 +279,57 @@ def test_reg011_quiet_on_negative_fixture(tmp_path):
     assert found == [], found
 
 
+def _knobs_repo(tmp_path: pathlib.Path, fixture: str) -> pathlib.Path:
+    """Mini repo for the REG012 fixtures: the fixture file under
+    pbccs_tpu/ plus a DESIGN.md knobs table listing `reg012_documented`
+    (env:PBCCS_DOCUMENTED) and `reg012_shifty` (flag:--shifty) only."""
+    pkg = tmp_path / "pbccs_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text((FIXTURES / fixture).read_text())
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "DESIGN.md").write_text(textwrap.dedent("""\
+        <!-- ccs-analyze:knobs-table:begin -->
+        | knob | target | source |
+        |---|---|---|
+        | `reg012_documented` | env:PBCCS_DOCUMENTED | `pbccs_tpu/mod.py` |
+        | `reg012_shifty` | flag:--shifty | `pbccs_tpu/mod.py` |
+        <!-- ccs-analyze:knobs-table:end -->
+    """))
+    return tmp_path
+
+
+def test_reg012_fires_on_positive_fixture(tmp_path):
+    pos, _neg = REPO_CASES["REG012"]
+    root = _knobs_repo(tmp_path, pos)
+    found = [f for f in run_passes(root) if f.rule == "REG012"]
+    # undeclared knob direction
+    assert any("reg012_alien" in f.message for f in found), found
+    # target-mismatch direction (env in code, flag in the table)
+    assert any("reg012_shifty" in f.message and "target" in f.message
+               for f in found), found
+
+
+def test_reg012_table_side_ghost_row_fires(tmp_path):
+    _pos, neg = REPO_CASES["REG012"]
+    root = _knobs_repo(tmp_path, neg)
+    design = root / "docs" / "DESIGN.md"
+    design.write_text(design.read_text().replace(
+        "<!-- ccs-analyze:knobs-table:end -->",
+        "| `reg012_ghost` | env:PBCCS_GHOST | `pbccs_tpu/mod.py` |\n"
+        "<!-- ccs-analyze:knobs-table:end -->"))
+    found = [f for f in run_passes(root) if f.rule == "REG012"]
+    assert any("reg012_ghost" in f.message
+               and f.path == "docs/DESIGN.md" for f in found), found
+
+
+def test_reg012_quiet_on_negative_fixture(tmp_path):
+    _pos, neg = REPO_CASES["REG012"]
+    root = _knobs_repo(tmp_path, neg)
+    found = [f for f in run_passes(root) if f.rule == "REG012"]
+    assert found == [], found
+
+
 def test_metric_kind_mismatch_is_drift(tmp_path):
     root = _mini_repo(tmp_path)
     (root / "docs" / "DESIGN.md").write_text(textwrap.dedent("""\

@@ -681,3 +681,217 @@ class TestFleetWiring:
             bad.close()
             replica.shutdown()
             eng.close()
+
+
+# ------------------------------------------- online token-map reload
+
+def write_tokens(path, rows):
+    path.write_text(json.dumps({"tenants": rows}))
+    return str(path)
+
+
+class TestReloadableDirectory:
+    """ReloadableTenantDirectory: the --authTokens file followed online
+    (SIGHUP or mtime change) without a rolling restart."""
+
+    def make(self, tmp_path, rows, **kw):
+        p = tmp_path / "tokens.json"
+        write_tokens(p, rows)
+        clock = [0.0]
+        rd = tenancy.ReloadableTenantDirectory(
+            str(p), clock=lambda: clock[0], **kw)
+        return rd, p, clock
+
+    def test_first_load_fails_loud(self, tmp_path):
+        p = tmp_path / "tokens.json"
+        p.write_text("{broken")
+        with pytest.raises(ValueError):
+            tenancy.ReloadableTenantDirectory(str(p))
+
+    def test_mtime_reload_revokes_and_admits(self, tmp_path):
+        rd, p, clock = self.make(
+            tmp_path, [{"name": "a", "token": "ta"}])
+        assert rd.authenticate("ta").name == "a"
+        time.sleep(0.01)
+        write_tokens(p, [{"name": "b", "token": "tb"}])
+        # inside the recheck window the old map still answers
+        assert rd.authenticate("ta") is not None
+        clock[0] = 5.0
+        assert rd.authenticate("ta") is None      # revoked
+        assert rd.authenticate("tb").name == "b"  # admitted
+        assert rd.get("b") is not None and rd.get("a") is None
+
+    def test_malformed_reload_keeps_previous_map(self, tmp_path):
+        scope = MeasurementScope(default_registry())
+        rd, p, clock = self.make(
+            tmp_path, [{"name": "a", "token": "ta"}])
+        time.sleep(0.01)
+        p.write_text("{broken")
+        clock[0] = 5.0
+        assert rd.authenticate("ta").name == "a"
+        assert scope.counter_value("ccs_tenant_map_reloads_total",
+                                   outcome="error") == 1
+        # a later GOOD edit recovers
+        time.sleep(0.01)
+        write_tokens(p, [{"name": "a", "token": "ta2"}])
+        clock[0] = 10.0
+        assert rd.authenticate("ta") is None
+        assert rd.authenticate("ta2").name == "a"
+
+    def test_sighup_bypasses_recheck_window(self, tmp_path):
+        import signal
+        rd, p, clock = self.make(
+            tmp_path, [{"name": "a", "token": "ta"}])
+        prev = signal.getsignal(signal.SIGHUP)
+        try:
+            assert rd.install_sighup() is True
+            time.sleep(0.01)
+            write_tokens(p, [{"name": "b", "token": "tb"}])
+            # clock never advances: only the signal can trigger reload
+            assert rd.authenticate("tb") is None
+            signal.raise_signal(signal.SIGHUP)
+            assert rd.authenticate("tb").name == "b"
+        finally:
+            signal.signal(signal.SIGHUP, prev)
+
+    def test_listener_and_fair_queue_refresh(self, tmp_path):
+        rd, p, clock = self.make(
+            tmp_path, [{"name": "a", "token": "ta", "max_inflight": 1}])
+        fq = FairQueue(rd)
+        rd.add_listener(fq.refresh)
+        assert fq.try_admit("a", 1) == "dispatch"
+        assert fq.try_admit("a", 2) == "queued"   # quota 1
+        time.sleep(0.01)
+        write_tokens(p, [
+            {"name": "a", "token": "ta", "max_inflight": 4},
+            {"name": "b", "token": "tb"}])
+        clock[0] = 5.0
+        rd.maybe_reload()
+        # new tenant has admission state (no KeyError) and the existing
+        # tenant adopted the raised quota without losing its counters
+        assert fq.try_admit("b", 3) == "dispatch"
+        assert fq.try_admit("a", 4) == "dispatch"
+        rows = {r["name"]: r for r in fq.rows()}
+        assert rows["a"]["max_inflight"] == 4
+        assert rows["a"]["queued"] == 1           # parked item survives
+
+    def test_token_revoked_mid_session(self, tmp_path):
+        """Regression: revoking a token must reject the session's NEXT
+        frame while the session itself (and its in-flight identity)
+        survives the reload."""
+        p = tmp_path / "tokens.json"
+        write_tokens(p, [{"name": "alpha", "token": "tok-alpha"},
+                         {"name": "beta", "token": "tok-beta"}])
+        clock = [0.0]
+        rd = tenancy.ReloadableTenantDirectory(
+            str(p), clock=lambda: clock[0])
+        eng = stub_engine(max_batch=2, max_wait_ms=20.0, max_pending=16)
+        eng.start()
+        srv = CcsServer(eng, port=0, tenants=rd)
+        srv.start()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=5.0) as s:
+                s.settimeout(5.0)
+                rf = s.makefile("rb")
+
+                def call(frame):
+                    s.sendall(protocol.encode_msg(frame))
+                    return protocol.decode_line(rf.readline())
+
+                r = call({"verb": "submit", "id": "s1", "zmw": ZMW,
+                          "auth": "tok-alpha"})
+                assert r["type"] == "result"
+                time.sleep(0.01)
+                write_tokens(p, [{"name": "beta", "token": "tok-beta"}])
+                clock[0] = 5.0
+                # same session, same token: the revocation bites on the
+                # next frame...
+                r = call({"verb": "submit", "id": "s2", "zmw": ZMW,
+                          "auth": "tok-alpha"})
+                assert r["type"] == "error"
+                assert r["code"] == protocol.ERR_UNAUTHORIZED
+                # ...but the session survives and a still-valid token
+                # keeps working over it
+                r = call({"verb": "submit", "id": "s3", "zmw": ZMW,
+                          "auth": "tok-beta"})
+                assert r["type"] == "result"
+        finally:
+            srv.shutdown()
+            eng.close()
+
+
+# --------------------------------------------- per-tenant SLO burn rate
+
+class TestPerTenantShedRate:
+    def burn_directory(self):
+        return directory(
+            Tenant("tolerant", "tok-tol", shed_burn_rate=0.95),
+            Tenant("strict", "tok-str", shed_burn_rate=0.2),
+            Tenant("alpha", "tok-alpha"),
+            Tenant("_router", "tok-router", priority=0, trusted=True))
+
+    def feed_burn(self, router, rate=0.9):
+        router._burn.observe("r", {"requests": 0, "violations": 0})
+        router._burn.observe("r", {"requests": 100,
+                                   "violations": int(100 * rate)})
+
+    def test_per_tenant_rate_overrides_fleet(self):
+        fake = FakeReplica()
+        router, server = make_tenant_router(
+            [fake], self.burn_directory(), shed_burn_threshold=0.5)
+        try:
+            self.feed_burn(router, 0.9)
+            # burn 0.9: the fleet default (0.5) sheds alpha, the strict
+            # tenant's own 0.2 sheds it too, the tolerant tenant's 0.95
+            # lets its work through
+            with CcsClient("127.0.0.1", server.port,
+                           auth_token="tok-tol") as cli:
+                assert cli.submit_wire(ZMW).reply(10.0)["type"] == "result"
+            for tok in ("tok-str", "tok-alpha"):
+                with CcsClient("127.0.0.1", server.port,
+                               auth_token=tok) as cli:
+                    with pytest.raises(ServeError) as ei:
+                        cli.submit_wire(ZMW).reply(10.0)
+                    assert ei.value.code == protocol.ERR_OVERLOADED, tok
+        finally:
+            router.close()
+            server.shutdown()
+            fake.close()
+
+    def test_per_tenant_rate_active_with_fleet_shedding_off(self):
+        fake = FakeReplica()
+        router, server = make_tenant_router(
+            [fake], self.burn_directory())   # fleet threshold 0 = off
+        try:
+            self.feed_burn(router, 0.9)
+            with CcsClient("127.0.0.1", server.port,
+                           auth_token="tok-str") as cli:
+                with pytest.raises(ServeError) as ei:
+                    cli.submit_wire(ZMW).reply(10.0)
+                assert ei.value.code == protocol.ERR_OVERLOADED
+            # no per-tenant rate + fleet off = no shedding at all
+            with CcsClient("127.0.0.1", server.port,
+                           auth_token="tok-alpha") as cli:
+                assert cli.submit_wire(ZMW).reply(10.0)["type"] == "result"
+        finally:
+            router.close()
+            server.shutdown()
+            fake.close()
+
+    def test_token_file_round_trip(self, tmp_path):
+        p = tmp_path / "tokens.json"
+        write_tokens(p, [
+            {"name": "a", "token": "ta", "shed_burn_rate": 0.5},
+            {"name": "b", "token": "tb"}])
+        d = TenantDirectory.from_file(str(p))
+        assert d.get("a").shed_burn_rate == 0.5
+        assert d.get("b").shed_burn_rate is None
+
+    @pytest.mark.parametrize("bad", [-0.1, 1.5, "half", True])
+    def test_bad_rates_rejected(self, tmp_path, bad):
+        p = tmp_path / "tokens.json"
+        write_tokens(p, [{"name": "a", "token": "ta",
+                          "shed_burn_rate": bad}])
+        with pytest.raises(ValueError, match="shed_burn_rate"):
+            TenantDirectory.from_file(str(p))

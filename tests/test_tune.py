@@ -1,0 +1,584 @@
+"""`ccs tune` tests: profiles, the resolution ladder, space, objective,
+journal resume, and the search driver (subprocessless, via a
+monkeypatched candidate runner).
+
+The ladder contract under test (runtime/tuning.py):
+
+    explicit flag / env  >  matching host profile  >  hand-tuned default
+
+plus the degradation rules: fingerprint mismatch falls through with a
+note, a corrupt/torn profile degrades without crashing, and nothing is
+ever applied unless --tuneProfile / PBCCS_TUNE_PROFILE opted in.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from pbccs_tpu.obs.metrics import default_registry
+from pbccs_tpu.runtime import tuning
+from pbccs_tpu.tune import driver, objective, space
+from pbccs_tpu.tune.profile import (
+    PROFILE_SCHEMA_VERSION,
+    HostProfile,
+    discover_profile,
+    fingerprint_mismatch,
+    host_fingerprint,
+    load_profile,
+    save_profile,
+)
+
+# ---------------------------------------------------------------- helpers
+
+
+@pytest.fixture(autouse=True)
+def clean_tuning_state(monkeypatch):
+    """Every test starts and ends on hand-tuned defaults, with no
+    ambient knob envs leaking in."""
+    for var in ("PBCCS_BAND_W", "PBCCS_DENSE_CB", "PBCCS_TUNE_PROFILE",
+                "PBCCS_TUNE_PROFILE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    tuning.reset()
+    yield
+    tuning.reset()
+
+
+def make_profile(knobs, fingerprint=None):
+    return HostProfile(fingerprint=fingerprint or host_fingerprint(),
+                       knobs=knobs)
+
+
+def write_profile(tmp_path, knobs, fingerprint=None, name="prof.json"):
+    path = str(tmp_path / name)
+    save_profile(make_profile(knobs, fingerprint), path)
+    return path
+
+
+class RecordingLog:
+    def __init__(self):
+        self.lines = []
+
+    def notice(self, msg):
+        self.lines.append(msg)
+
+    info = warn = notice
+
+    def text(self):
+        return "\n".join(self.lines)
+
+
+# ---------------------------------------------------------------- profiles
+
+
+class TestHostProfile:
+    def test_round_trip(self, tmp_path):
+        path = write_profile(tmp_path, {"band_w": 48, "dense_cb": 2,
+                                        "warmup_buckets": ["8x3x120"]})
+        prof, note = load_profile(path)
+        assert note is None
+        assert prof.knobs == {"band_w": 48, "dense_cb": 2,
+                              "warmup_buckets": ["8x3x120"]}
+        assert prof.schema_version == PROFILE_SCHEMA_VERSION
+
+    def test_profile_id_tracks_content(self):
+        fp = {"platform": "cpu", "device_kind": "cpu",
+              "device_count": 1, "jax_version": "1"}
+        a = HostProfile(fingerprint=fp, knobs={"band_w": 48})
+        b = HostProfile(fingerprint=fp, knobs={"band_w": 48})
+        c = HostProfile(fingerprint=fp, knobs={"band_w": 96})
+        assert a.profile_id == b.profile_id
+        assert a.profile_id != c.profile_id
+        assert len(a.profile_id) == 12
+
+    def test_missing_file_degrades(self, tmp_path):
+        prof, note = load_profile(str(tmp_path / "nope.json"))
+        assert prof is None and "cannot read" in note
+
+    @pytest.mark.parametrize("content", [
+        "{torn",                                   # torn tail / not JSON
+        "[]",                                      # alien shape
+        json.dumps({"profile_schema_version": 99,
+                    "fingerprint": {}, "knobs": {}}),   # future schema
+        json.dumps({"profile_schema_version": 1,
+                    "fingerprint": {"platform": "cpu"},
+                    "knobs": {}}),                 # incomplete fingerprint
+        json.dumps({"profile_schema_version": 1,
+                    "fingerprint": {"platform": "cpu", "device_kind": "c",
+                                    "device_count": 1, "jax_version": "1"},
+                    "knobs": {"band_w": True}}),   # bool knob value
+    ])
+    def test_corrupt_profiles_degrade(self, tmp_path, content):
+        p = tmp_path / "bad.json"
+        p.write_text(content)
+        prof, note = load_profile(str(p))
+        assert prof is None
+        assert note  # every degradation is explained
+
+    def test_fingerprint_mismatch_names_field(self):
+        host = host_fingerprint()
+        other = dict(host, device_kind="TPU v5e")
+        note = fingerprint_mismatch(other, host)
+        assert "device_kind" in note
+        assert fingerprint_mismatch(dict(host), host) is None
+
+    def test_discover_picks_matching_skips_alien(self, tmp_path):
+        host = host_fingerprint()
+        write_profile(tmp_path, {"band_w": 48},
+                      fingerprint=dict(host, jax_version="0.0.1"),
+                      name="a-othergen.json")
+        match = write_profile(tmp_path, {"band_w": 80}, name="b-this.json")
+        # sorts before the match, so discovery must tolerate + explain it
+        (tmp_path / "0-junk.json").write_text("{torn")
+        prof, notes = discover_profile(str(tmp_path), host)
+        assert prof is not None and prof.knobs["band_w"] == 80
+        # the near-miss and the corrupt file are both explained
+        assert any("jax_version" in n for n in notes)
+        assert any("0-junk" in n for n in notes)
+        assert os.path.exists(match)
+
+    def test_discover_empty_dir(self, tmp_path):
+        prof, notes = discover_profile(str(tmp_path), host_fingerprint())
+        assert prof is None
+        assert any("no profile" in n for n in notes)
+
+
+# ------------------------------------------------------- resolution ladder
+
+
+class TestResolutionLadder:
+    def test_opt_in_only(self, tmp_path):
+        """No spec, no env: nothing loads, knobs resolve to None."""
+        assert tuning.configure(None) is False
+        assert tuning.active_profile() is None
+        assert tuning.knob_int("band_w") is None
+        assert tuning.ledger_tag() == "none"
+        for off in ("", "off", "none", "OFF"):
+            assert tuning.configure(off) is False
+
+    def test_profile_applies_and_attributes(self, tmp_path):
+        path = write_profile(tmp_path, {"band_w": 48,
+                                        "serve_max_wait_ms": 100.0})
+        log = RecordingLog()
+        assert tuning.configure(path, logger=log) is True
+        prof = tuning.active_profile()
+        assert tuning.knob_int("band_w") == 48
+        assert tuning.knob_float("serve_max_wait_ms") == 100.0
+        assert tuning.ledger_tag() == prof.profile_id
+        assert "applied host profile" in log.text()
+        # the applied gauge carries the profile id as a label
+        text = default_registry().render_prometheus()
+        assert "ccs_tune_profile_applied" in text
+        assert prof.profile_id in text
+
+    def test_env_spec_equivalent_to_flag(self, tmp_path, monkeypatch):
+        path = write_profile(tmp_path, {"band_w": 80})
+        monkeypatch.setenv("PBCCS_TUNE_PROFILE", path)
+        assert tuning.configure(None) is True
+        assert tuning.knob_int("band_w") == 80
+
+    def test_band_w_flag_beats_profile_beats_default(self, tmp_path,
+                                                     monkeypatch):
+        from pbccs_tpu.models.arrow.params import (
+            BandingOptions,
+            effective_band_width,
+        )
+
+        # default schedule: 64 short, 96 long
+        assert effective_band_width(BandingOptions(), 256) == 64
+        # profile overrides the schedule default...
+        tuning.configure(write_profile(tmp_path, {"band_w": 48}))
+        assert effective_band_width(BandingOptions(), 256) == 48
+        # ...env beats profile...
+        monkeypatch.setenv("PBCCS_BAND_W", "72")
+        assert effective_band_width(BandingOptions(), 256) == 72
+        # ...explicit config beats everything
+        assert effective_band_width(
+            BandingOptions(band_width=128), 256) == 128
+
+    def test_dense_cb_flag_beats_profile_beats_default(self, tmp_path,
+                                                       monkeypatch):
+        from pbccs_tpu.ops.dense_score_pallas import (
+            _CB_DEFAULT,
+            dense_cols_per_step,
+        )
+
+        assert dense_cols_per_step(64) == _CB_DEFAULT
+        tuning.configure(write_profile(tmp_path, {"dense_cb": 2}))
+        assert dense_cols_per_step(64) == 2
+        monkeypatch.setenv("PBCCS_DENSE_CB", "8")
+        assert dense_cols_per_step(64) == 8
+        # the block-count clamp still applies to tuned values
+        monkeypatch.delenv("PBCCS_DENSE_CB")
+        assert dense_cols_per_step(1) == 1
+
+    def test_serve_and_router_flags_default_to_ladder(self):
+        """--maxBatch/--maxWaitMs/--routerSpillDepth parse to None so
+        run_serve/run_router can resolve flag > profile > default."""
+        from pbccs_tpu.serve.router import build_router_parser
+        from pbccs_tpu.serve.server import build_serve_parser
+
+        s = build_serve_parser().parse_args([])
+        assert s.maxBatch is None and s.maxWaitMs is None
+        assert s.tuneProfile is None
+        r = build_router_parser().parse_args(["--replica", "h:1"])
+        assert r.routerSpillDepth is None and r.tuneProfile is None
+
+    def test_warmup_bucket_menu_from_profile(self, tmp_path):
+        from pbccs_tpu.sched.warmup import build_parser
+
+        args = build_parser().parse_args([])
+        assert args.bucket is None   # optional when a profile supplies it
+        tuning.configure(write_profile(
+            tmp_path, {"warmup_buckets": ["8x3x120", "16x6x300"]}))
+        assert tuning.knob_str_list("warmup_buckets") == \
+            ["8x3x120", "16x6x300"]
+
+    def test_fingerprint_mismatch_falls_through_with_note(self, tmp_path):
+        host = host_fingerprint()
+        path = write_profile(
+            tmp_path, {"band_w": 48},
+            fingerprint=dict(host, device_kind="TPU v5e"))
+        log = RecordingLog()
+        assert tuning.configure(path, logger=log) is False
+        assert tuning.active_profile() is None
+        assert "device_kind" in log.text()
+        assert "hand-tuned defaults" in log.text()
+
+    def test_corrupt_profile_degrades_without_crashing(self, tmp_path):
+        p = tmp_path / "torn.json"
+        p.write_text('{"profile_schema_version": 1, "knobs": {"ban')
+        log = RecordingLog()
+        assert tuning.configure(str(p), logger=log) is False
+        assert tuning.knob_int("band_w") is None
+        assert "not valid JSON" in log.text()
+
+    def test_auto_discovery_scans_profile_dir(self, tmp_path,
+                                              monkeypatch):
+        write_profile(tmp_path, {"band_w": 48})
+        monkeypatch.setenv("PBCCS_TUNE_PROFILE_DIR", str(tmp_path))
+        assert tuning.configure("auto") is True
+        assert tuning.knob_int("band_w") == 48
+
+    def test_knob_type_guards(self, tmp_path):
+        tuning.configure(write_profile(
+            tmp_path, {"band_w": 48, "warmup_buckets": ["8x3x120"],
+                       "label": "text"}))
+        assert tuning.knob_int("warmup_buckets") is None
+        assert tuning.knob_float("label") is None
+        assert tuning.knob_str_list("band_w") is None
+
+
+# ------------------------------------------------------------- knob space
+
+
+class TestKnobSpace:
+    def test_targets_cover_every_declared_knob(self):
+        declared = {k.name for k in
+                    (*space.BATCH_KNOBS, *space.SERVE_KNOBS)}
+        declared.update(space.PROFILE_ONLY_KNOBS)
+        assert declared == set(space.KNOB_TARGETS)
+
+    def test_candidate_invocation_env_and_cli(self):
+        argv, env = space.candidate_invocation(
+            {"band_w": 48, "prepare_workers": 2})
+        assert env == {"PBCCS_BAND_W": "48"}
+        assert argv == ["--prepareWorkers", "2"]
+
+    def test_candidate_invocation_rejects_profile_knobs(self):
+        with pytest.raises(ValueError, match="not batch-sweepable"):
+            space.candidate_invocation({"serve_max_batch": 8})
+        with pytest.raises(ValueError, match="not batch-sweepable"):
+            space.candidate_invocation({"mystery": 1})
+
+    def test_affected_fields_union(self):
+        assert space.affected_fields(
+            {"band_w": 48, "mem_budget_bytes": 1 << 28}) == {
+                "compiles", "compile_cache_hits", "compile_cache_misses",
+                "budget_throttles"}
+        assert space.affected_fields({"prepare_workers": 2}) == set()
+
+    def test_batch_space_restrict_and_override(self):
+        knobs = space.batch_space(["band_w"], {"band_w": (40, 56)})
+        assert [k.name for k in knobs] == ["band_w"]
+        assert knobs[0].candidates == (40, 56)
+        # the master definition is untouched
+        assert space.knob_by_name("band_w").candidates == (48, 64, 80, 96)
+
+
+# -------------------------------------------------------------- objective
+
+
+def meas(zps, wall=10.0, **kw):
+    return objective.Measurement(zmws_per_sec=zps, wall_s=wall, **kw)
+
+
+class TestObjective:
+    def test_measure_medians(self):
+        records = [
+            {"kind": "batch_run", "zmws_per_sec": 10.0, "wall_s": 6.4,
+             "padding_waste": 0.25, "peak_rss_bytes": 100},
+            {"kind": "batch_run", "zmws_per_sec": 30.0, "wall_s": 2.1,
+             "padding_waste": 0.25, "peak_rss_bytes": 300},
+            {"kind": "batch_run", "zmws_per_sec": 20.0, "wall_s": 3.2,
+             "padding_waste": 0.25, "peak_rss_bytes": 200},
+        ]
+        m = objective.measure(records)
+        assert m.zmws_per_sec == 20.0 and m.wall_s == 3.2
+        assert m.peak_rss_bytes == 200 and m.repeats == 3
+
+    def test_measure_requires_throughput(self):
+        assert objective.measure([{"kind": "batch_run"}]) is None
+        assert objective.measure([]) is None
+
+    def test_better_primary_and_ties(self):
+        base = meas(100.0, padding_waste=0.2, peak_rss_bytes=100)
+        assert objective.better(meas(110.0), base)          # clear win
+        assert not objective.better(meas(90.0), base)       # clear loss
+        # inside the tie band the tie-breakers decide
+        tie_better = meas(101.0, padding_waste=0.1, peak_rss_bytes=100)
+        tie_worse = meas(101.0, padding_waste=0.3, peak_rss_bytes=50)
+        tie_equal = meas(100.0, padding_waste=0.2, peak_rss_bytes=100)
+        assert objective.better(tie_better, base)
+        assert not objective.better(tie_worse, base)
+        assert not objective.better(tie_equal, base)  # incumbent keeps
+
+
+# ---------------------------------------------------------------- journal
+
+
+class TestJournal:
+    def test_round_trip_and_resume(self, tmp_path):
+        path = str(tmp_path / "journal.ndjson")
+        j = driver.Journal(path, resume=False)
+        res = driver.CandidateResult(
+            {"band_w": 48}, ok=True, digest="d1",
+            measurement=meas(10.0), records=[{"kind": "batch_run"}])
+        j.put(res)
+        j.put(driver.CandidateResult({"band_w": 96}, ok=False,
+                                     reason="boom"))
+        j2 = driver.Journal(path, resume=True)
+        back = j2.get(driver.assignment_key({"band_w": 48}))
+        assert back.ok and back.digest == "d1"
+        assert back.measurement.zmws_per_sec == 10.0
+        bad = j2.get(driver.assignment_key({"band_w": 96}))
+        assert not bad.ok and bad.reason == "boom"
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "journal.ndjson")
+        j = driver.Journal(path, resume=False)
+        j.put(driver.CandidateResult({"band_w": 48}, ok=True,
+                                     digest="d", measurement=meas(10.0)))
+        with open(path, "a") as fh:
+            fh.write('{"tune_journal": 1, "assignment": {"band_')
+        j2 = driver.Journal(path, resume=True)
+        assert j2.get(driver.assignment_key({"band_w": 48})) is not None
+
+    def test_fresh_run_truncates(self, tmp_path):
+        path = str(tmp_path / "journal.ndjson")
+        j = driver.Journal(path, resume=False)
+        j.put(driver.CandidateResult({}, ok=True, digest="d",
+                                     measurement=meas(10.0)))
+        j3 = driver.Journal(path, resume=False)   # no --resume: start over
+        assert j3.get(driver.assignment_key({})) is None
+
+
+# ------------------------------------------------------------ search driver
+
+
+def batch_record(zps, *, compiles=3, dispatches=5, jax="j", wall=None):
+    return {"kind": "batch_run", "schema_version": 1,
+            "jax_version": jax, "platform": "cpu",
+            "zmws_per_sec": zps, "wall_s": wall or round(64.0 / zps, 4),
+            "polish_dispatches": dispatches, "compiles": compiles,
+            "padding_waste": 0.1}
+
+
+class FakeRunner:
+    """Stands in for driver._run_candidate: a scripted candidate table
+    keyed by assignment, counting invocations for resume assertions."""
+
+    def __init__(self, table):
+        self.table = table
+        self.calls = []
+
+    def __call__(self, cfg, assignment, calib):
+        self.calls.append(dict(assignment))
+        spec = self.table[driver.assignment_key(assignment)]
+        if "reason" in spec:
+            return driver.CandidateResult(assignment, ok=False,
+                                          reason=spec["reason"])
+        records = [batch_record(spec["zps"], **spec.get("rec", {}))
+                   for _ in range(3)]
+        return driver.CandidateResult(
+            assignment, ok=True, digest=spec.get("digest", "base"),
+            measurement=objective.measure(records), records=records)
+
+
+def tune_cfg(tmp_path, knobs, **kw):
+    cfg = driver.TuneConfig(
+        workdir=str(tmp_path / "work"),
+        out_path=str(tmp_path / "prof.json"),
+        zmws=8, passes=3, tpl_len=120, chunk_size=8, repeat=3,
+        knobs=knobs, **kw)
+    os.makedirs(cfg.workdir, exist_ok=True)
+    # the fake runner never reads the calibration file; skip synthesis
+    open(os.path.join(cfg.workdir, "calibration.fasta"), "w").close()
+    return cfg
+
+
+@pytest.fixture
+def one_knob():
+    return [dataclasses.replace(space.knob_by_name("band_w"),
+                                candidates=(48, 96))]
+
+
+class TestRunSearch:
+    def test_winner_ships_profile_loader_applies_it(self, tmp_path,
+                                                    monkeypatch,
+                                                    one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"zps": 10.0},
+            # band_w=48 is faster and only perturbs its declared
+            # side-effect field (compile counts)
+            driver.assignment_key({"band_w": 48}):
+                {"zps": 14.0, "rec": {"compiles": 7}},
+            driver.assignment_key({"band_w": 96}): {"zps": 9.0},
+        })
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(tune_cfg(tmp_path, one_knob))
+        assert summary["shipped"] is True
+        assert summary["winner"]["assignment"] == {"band_w": 48}
+        assert summary["winner"]["gain"] == pytest.approx(0.4)
+        assert summary["referee"]["violations"] == []
+        # the emitted profile round-trips through the loader
+        assert tuning.configure(summary["profile"]) is True
+        assert tuning.knob_int("band_w") == 48
+        assert tuning.knob_str_list("warmup_buckets") == ["8x3x120"]
+        assert tuning.ledger_tag() == summary["profile_id"]
+
+    def test_output_change_rejected_not_ranked(self, tmp_path,
+                                               monkeypatch, one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"zps": 10.0},
+            # faster but byte-different: MUST be rejected + reported
+            driver.assignment_key({"band_w": 48}):
+                {"zps": 50.0, "digest": "DIFFERENT"},
+            driver.assignment_key({"band_w": 96}): {"zps": 9.0},
+        })
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(tune_cfg(tmp_path, one_knob))
+        assert summary["shipped"] is False
+        reasons = [r["reason"] for r in summary["rejected"]]
+        assert any("output differs" in r for r in reasons)
+        assert not os.path.exists(str(tmp_path / "prof.json"))
+
+    def test_referee_counter_drift_blocks_ship(self, tmp_path,
+                                               monkeypatch, one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"zps": 10.0},
+            # same bytes, faster, but a NON-exempt counter drifted:
+            # the perf_gate referee must veto the profile
+            driver.assignment_key({"band_w": 48}):
+                {"zps": 14.0, "rec": {"dispatches": 9}},
+            driver.assignment_key({"band_w": 96}): {"zps": 9.0},
+        })
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(tune_cfg(tmp_path, one_knob))
+        assert summary["shipped"] is False
+        bad = summary["referee"]["violations"]
+        assert any(v["metric"] == "polish_dispatches" for v in bad)
+        assert "NOT shipped" in summary["note"]
+
+    def test_min_gain_gates_ship(self, tmp_path, monkeypatch, one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"zps": 10.0},
+            driver.assignment_key({"band_w": 48}): {"zps": 10.5},
+            driver.assignment_key({"band_w": 96}): {"zps": 9.0},
+        })
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(
+            tune_cfg(tmp_path, one_knob, min_gain=0.10))
+        assert summary["shipped"] is False
+        assert "--minGain" in summary["note"]
+        # smoke mode: negative min_gain force-ships a clean winner
+        summary = driver.run_search(
+            tune_cfg(tmp_path, one_knob, min_gain=-1.0))
+        assert summary["shipped"] is True
+
+    def test_no_winner_nothing_to_ship(self, tmp_path, monkeypatch,
+                                       one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"zps": 10.0},
+            driver.assignment_key({"band_w": 48}): {"zps": 8.0},
+            driver.assignment_key({"band_w": 96}): {"zps": 9.0},
+        })
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(tune_cfg(tmp_path, one_knob))
+        assert summary["shipped"] is False
+        assert "nothing to ship" in summary["note"]
+
+    def test_joint_refine_and_resume(self, tmp_path, monkeypatch):
+        knobs = [
+            dataclasses.replace(space.knob_by_name("band_w"),
+                                candidates=(48,)),
+            dataclasses.replace(space.knob_by_name("prepare_workers"),
+                                candidates=(2,)),
+        ]
+        table = {
+            driver.assignment_key({}): {"zps": 10.0},
+            driver.assignment_key({"band_w": 48}): {"zps": 12.0},
+            driver.assignment_key({"prepare_workers": 2}): {"zps": 11.0},
+            driver.assignment_key({"band_w": 48, "prepare_workers": 2}):
+                {"zps": 13.0},
+        }
+        runner = FakeRunner(table)
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        cfg = tune_cfg(tmp_path, knobs)
+        summary = driver.run_search(cfg)
+        assert summary["shipped"] is True
+        assert summary["winner"]["assignment"] == \
+            {"band_w": 48, "prepare_workers": 2}
+        measured_once = len(runner.calls)
+        # resume: every candidate comes back from the journal
+        cfg2 = tune_cfg(tmp_path, knobs, resume=True)
+        summary2 = driver.run_search(cfg2)
+        assert summary2["winner"] == summary["winner"]
+        assert len(runner.calls) == measured_once   # zero re-measures
+
+    def test_defaults_run_failure_is_an_error(self, tmp_path,
+                                              monkeypatch, one_knob):
+        runner = FakeRunner({
+            driver.assignment_key({}): {"reason": "exploded"}})
+        monkeypatch.setattr(driver, "_run_candidate", runner)
+        summary = driver.run_search(tune_cfg(tmp_path, one_knob))
+        assert "error" in summary and "exploded" in summary["error"]
+
+
+# -------------------------------------------------------------- perf_gate
+
+
+class TestRefereeIgnore:
+    def test_ignore_exempts_and_notes(self):
+        pg = driver._load_perf_gate()
+        base_records = [batch_record(10.0)]
+        baseline = pg.build_baseline(base_records,
+                                     select={"kind": "batch_run"})
+        drifted = [batch_record(10.0, compiles=9)]
+        violations, _ = pg.compare(baseline, drifted, counters_only=True)
+        assert any(v["metric"] == "compiles" for v in violations)
+        violations, notes = pg.compare(
+            baseline, drifted, counters_only=True, ignore={"compiles"})
+        assert violations == []
+        assert any("exempted" in n for n in notes)
+
+    def test_ignore_does_not_mask_other_drift(self):
+        pg = driver._load_perf_gate()
+        baseline = pg.build_baseline([batch_record(10.0)],
+                                     select={"kind": "batch_run"})
+        drifted = [batch_record(10.0, compiles=9, dispatches=8)]
+        violations, _ = pg.compare(
+            baseline, drifted, counters_only=True, ignore={"compiles"})
+        assert any(v["metric"] == "polish_dispatches"
+                   for v in violations)

@@ -165,20 +165,31 @@ def _violation(metric: str, cls: str, base, obs, tol) -> dict:
 
 def compare(baseline: dict, records: list[dict], *,
             counters_only: bool = False,
-            all_records: list[dict] | None = None
+            all_records: list[dict] | None = None,
+            ignore: set[str] | frozenset[str] | None = None
             ) -> tuple[list[dict], list[str]]:
     """(violations, notes) of the observed ledger records vs baseline.
 
     `records` are the selector-matched records the class bands run
     over; `all_records` (default: same) is the whole ledger, which
     floors may fall back to for fields only specialized record kinds
-    carry (e.g. tenant_snapshot's tenant_b_p99_gain)."""
+    carry (e.g. tenant_snapshot's tenant_b_p99_gain).  `ignore` names
+    metrics exempt from enforcement (noted, not silently dropped) --
+    the ccs-tune referee uses it for fields a candidate knob
+    legitimately perturbs (e.g. band_w changes compile counts)."""
     tol = {**DEFAULT_TOLERANCES, **(baseline.get("tolerances") or {})}
     base_metrics = baseline.get("metrics") or {}
     obs = observed_metrics(records)
     last = records[-1]
     notes: list[str] = []
     violations: list[dict] = []
+    if ignore:
+        exempt = sorted(set(ignore) & set(base_metrics))
+        if exempt:
+            base_metrics = {k: v for k, v in base_metrics.items()
+                            if k not in ignore}
+            notes.append("metrics exempted by --ignore: "
+                         + ", ".join(exempt))
 
     jax_match = (last.get("jax_version") == baseline.get("jax_version"))
     platform = last.get("platform")
@@ -342,6 +353,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--counters-only", action="store_true",
                    help="Enforce only the CPU-deterministic classes "
                         "(counter/ratio/compile); the tier-1 CI mode.")
+    p.add_argument("--ignore", action="append", default=[],
+                   metavar="METRIC",
+                   help="Exempt a metric from enforcement (repeatable; "
+                        "noted on stderr, never silent). The ccs-tune "
+                        "referee's escape hatch for fields a candidate "
+                        "knob legitimately perturbs.")
     p.add_argument("--kind", default=None,
                    help="Override the baseline's record-kind selector.")
     p.add_argument("--source", default=None,
@@ -404,7 +421,8 @@ def main(argv: list[str] | None = None) -> int:
 
     violations, notes = compare(baseline, matching,
                                 counters_only=args.counters_only,
-                                all_records=records)
+                                all_records=records,
+                                ignore=set(args.ignore) or None)
     for note in notes:
         print(f"perf_gate: note: {note}", file=sys.stderr)
     if violations:

@@ -67,6 +67,11 @@ echo "== roofline smoke (CostCard determinism + ccs roofline + efficiency floor 
 # parse, and perf_gate must enforce the new roofline fields + floor
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/roofline_smoke.py || exit 1
 
+echo "== tune smoke (ccs tune: output-change rejection, profile ship, loader ladder, attribution) =="
+# one real search over a loaded band-width grid: the output-changing
+# candidate must be rejected, the profile must ship + apply + stamp
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/tune_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

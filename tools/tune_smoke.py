@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""`ccs tune` smoke for the tier-1 gate: the autotuner's four promises,
+end to end on a tiny CPU workload.
+
+Runs ONE real `ccs tune` search (fresh subprocesses per candidate, the
+production driver, no mocks) over a deliberately loaded two-candidate
+band-width grid -- `band_w=16` is empirically output-CHANGING on this
+workload, `band_w=48` is byte-identical and less work than the default
+64 -- then asserts:
+
+  1. REJECTION: the output-changing candidate is rejected and REPORTED
+     (`output differs from defaults`), never ranked -- the
+     byte-identity rule is the autotuner's safety contract;
+  2. SHIP: a profile is emitted (`--minGain -1` smoke mode +
+     `--set router_spill_depth=4`, so a ship never depends on CPU
+     timing luck), schema-versioned, fingerprinted for THIS host, and
+     referee-clean (perf_gate violations empty, band_w's declared
+     compile-count exemptions noted);
+  3. LOADER: runtime.tuning applies the emitted profile in-process
+     (knobs resolve, `ledger_tag` == profile id) and a fingerprint
+     mutation makes it fall through to defaults with a note;
+  4. END TO END: a fresh batch CLI run under `--tuneProfile` produces
+     output byte-identical to the tune search's defaults run and
+     stamps `tuned_profile=<id>` into its perf-ledger records.
+
+The emitted profile is copied to $ARTIFACTS_DIR (default
+/tmp/ccs-tune-artifacts) for CI upload.
+
+Usage:  JAX_PLATFORMS=cpu python tools/tune_smoke.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ZMWS = 8
+TPL_LEN = 120
+N_PASSES = 3
+CHUNK = 8
+BAD_BAND_W = 16    # empirically changes consensus bytes on this workload
+GOOD_BAND_W = 48   # byte-identical, narrower than the default 64
+
+
+def fail(msg: str) -> None:
+    print(f"tune_smoke: FAIL: {msg}")
+    sys.exit(1)
+
+
+def sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def run_tune(workdir: str, out_path: str) -> dict:
+    cmd = [sys.executable, "-m", "pbccs_tpu.cli", "tune",
+           "--out", out_path, "--workdir", workdir,
+           "--zmws", str(N_ZMWS), "--passes", str(N_PASSES),
+           "--tplLen", str(TPL_LEN), "--chunkSize", str(CHUNK),
+           "--repeat", "1", "--devices", "1",
+           "--knobs", "band_w",
+           "--candidates", f"band_w={BAD_BAND_W},{GOOD_BAND_W}",
+           "--set", "router_spill_depth=4",
+           "--minGain", "-1", "--logLevel", "WARN"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PBCCS_TUNE_PROFILE", None)
+    proc = subprocess.run(cmd, env=env, cwd=REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"ccs tune exited {proc.returncode}:\n"
+             f"{proc.stderr[-1500:]}\n{proc.stdout[-500:]}")
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        fail(f"ccs tune printed no JSON summary line: {proc.stdout!r}")
+        raise  # unreachable; keeps type-checkers quiet
+
+
+def defaults_digest(workdir: str) -> str:
+    """The tune search's own record of the defaults-run output digest,
+    read back from its resume journal."""
+    from pbccs_tpu.obs.ledger import read_ledger
+    from pbccs_tpu.tune.driver import assignment_key
+
+    records, _ = read_ledger(os.path.join(workdir, "journal.ndjson"))
+    for rec in records:
+        if rec.get("tune_journal") == 1 and rec.get("assignment") == {}:
+            return rec.get("digest") or ""
+    fail("tune journal carries no defaults-run digest")
+    raise AssertionError  # unreachable
+
+
+def check_loader(out_path: str, summary: dict) -> None:
+    from pbccs_tpu.runtime import tuning
+    from pbccs_tpu.tune.profile import load_profile, save_profile
+
+    prof, note = load_profile(out_path)
+    if prof is None:
+        fail(f"emitted profile does not load: {note}")
+    if prof.profile_id != summary.get("profile_id"):
+        fail(f"profile id drift: file {prof.profile_id} vs summary "
+             f"{summary.get('profile_id')}")
+
+    tuning.reset()
+    if not tuning.configure(out_path):
+        fail("tuning.configure refused the emitted profile on the "
+             "host that produced it")
+    if tuning.knob_int("router_spill_depth") != 4:
+        fail("forced knob router_spill_depth did not resolve from the "
+             "applied profile")
+    if tuning.ledger_tag() != prof.profile_id:
+        fail(f"ledger_tag {tuning.ledger_tag()!r} != applied profile "
+             f"id {prof.profile_id}")
+    print(f"tune_smoke: loader applied profile {prof.profile_id} "
+          f"(knobs {sorted(prof.knobs)})")
+
+    # fingerprint mismatch must fall through to defaults, not crash
+    import dataclasses
+
+    alien = dataclasses.replace(
+        prof, fingerprint=dict(prof.fingerprint, jax_version="0.0.0"))
+    alien_path = out_path + ".alien"
+    save_profile(alien, alien_path)
+    tuning.reset()
+    if tuning.configure(alien_path):
+        fail("a fingerprint-mismatched profile was applied")
+    if tuning.knob_int("router_spill_depth") is not None:
+        fail("knobs leaked through a rejected profile")
+    tuning.reset()
+    print("tune_smoke: fingerprint mismatch falls through to defaults")
+
+
+def check_end_to_end(workdir: str, out_path: str, summary: dict) -> None:
+    """A fresh batch CLI run under --tuneProfile: byte-identical output
+    to the tune search's defaults run, tuned_profile stamped in the
+    ledger."""
+    from pbccs_tpu.obs.ledger import read_ledger
+
+    calib = os.path.join(workdir, "calibration.fasta")
+    out = os.path.join(workdir, "tuned_run.fasta")
+    ledger = os.path.join(workdir, "tuned_run.ndjson")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pbccs_tpu.cli", out, calib,
+           "--skipChemistryCheck", "--devices", "1",
+           "--chunkSize", str(CHUNK), "--perfLedger", ledger,
+           "--reportFile", os.path.join(workdir, "tuned_run_report.csv"),
+           "--tuneProfile", out_path, "--logLevel", "WARN"]
+    proc = subprocess.run(cmd, env=env, cwd=REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"--tuneProfile run exited {proc.returncode}: "
+             f"{proc.stderr[-1000:]}")
+    want = defaults_digest(workdir)
+    got = sha256(out)
+    if got != want:
+        fail(f"tuned run output digest {got[:12]} != defaults "
+             f"{want[:12]} -- the shipped profile changed the answer")
+    records, _ = read_ledger(ledger)
+    runs = [r for r in records if r.get("kind") == "batch_run"]
+    if not runs:
+        fail("tuned run produced no batch_run ledger record")
+    tags = {r.get("tuned_profile") for r in runs}
+    if tags != {summary["profile_id"]}:
+        fail(f"ledger tuned_profile {tags} != shipped profile id "
+             f"{summary['profile_id']}")
+    print("tune_smoke: --tuneProfile run byte-identical to defaults, "
+          f"ledger stamped tuned_profile={summary['profile_id']}")
+
+
+def main() -> None:
+    t0 = time.monotonic()
+    workdir = tempfile.mkdtemp(prefix="ccs_tune_smoke_")
+    out_path = os.path.join(workdir, "profile.json")
+
+    summary = run_tune(workdir, out_path)
+
+    # 1. the output-changing candidate is rejected + reported
+    bad = [r for r in summary.get("rejected", [])
+           if r.get("assignment") == {"band_w": BAD_BAND_W}]
+    if not bad:
+        fail(f"band_w={BAD_BAND_W} was not rejected: "
+             f"{json.dumps(summary)[:800]}")
+    if "output differs from defaults" not in bad[0].get("reason", ""):
+        fail(f"wrong rejection reason: {bad[0]}")
+    print(f"tune_smoke: band_w={BAD_BAND_W} rejected "
+          f"({bad[0]['reason']})")
+
+    # 2. a profile shipped, referee-clean
+    if not summary.get("shipped"):
+        fail(f"no profile shipped: {json.dumps(summary)[:800]}")
+    if summary["referee"]["violations"]:
+        fail(f"referee violations on the shipped winner: "
+             f"{summary['referee']['violations']}")
+    if not os.path.exists(out_path):
+        fail(f"summary says shipped but {out_path} does not exist")
+    win = summary["winner"]["assignment"]
+    print(f"tune_smoke: shipped {summary['profile_id']} "
+          f"(winner {win or 'defaults'}, gain "
+          f"{summary['winner']['gain']:+.2%}, referee clean)")
+    if win.get("band_w") == BAD_BAND_W:
+        fail("the output-changing candidate won the search")
+
+    # 3. loader ladder
+    check_loader(out_path, summary)
+
+    # 4. end-to-end apply + attribution
+    check_end_to_end(workdir, out_path, summary)
+
+    art_dir = os.environ.get("ARTIFACTS_DIR", "/tmp/ccs-tune-artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    shutil.copy(out_path, os.path.join(art_dir, "tune_profile.json"))
+    print(f"tune_smoke: profile artifact -> "
+          f"{os.path.join(art_dir, 'tune_profile.json')}")
+    print(f"tune_smoke: PASS in {time.monotonic() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
